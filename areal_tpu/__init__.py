@@ -1,0 +1,10 @@
+"""AReaL-TPU: a TPU-native asynchronous RL training framework for LLMs.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of AReaL
+(reference: zhshgmail/AReaL): fully-asynchronous rollout generation decoupled
+from training, staleness-aware capacity control, decoupled-PPO objectives,
+and trainer->inference weight synchronization — built on jax.sharding meshes,
+pjit/GSPMD collectives, and Pallas kernels instead of CUDA/NCCL/torch.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,514 @@
+"""Structured configuration for every subsystem.
+
+Capability counterpart of the reference's `areal/api/cli_args.py` (1314 LoC of
+dataclasses + OmegaConf/Hydra loading).  Re-designed without OmegaConf: a plain
+dataclass tree plus a small recursive YAML/dot-list merge (`load_expr_config`),
+which covers the reference's `cli_args.py:1247-1310` behavior (YAML file +
+`a.b.c=value` command-line overrides).
+"""
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, Dict, List, Optional, Tuple, Type, TypeVar, Union, get_args, get_origin
+
+import yaml
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# Generation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GenerationHyperparameters:
+    """Per-request sampling config (reference: cli_args.py GenerationHyperparameters)."""
+
+    n_samples: int = 1
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    greedy: bool = False
+    stop_token_ids: List[int] = field(default_factory=list)
+    stop: List[str] = field(default_factory=list)
+    frequency_penalty: float = 0.0
+
+    def new(self, **kwargs) -> "GenerationHyperparameters":
+        return dataclasses.replace(self, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / train engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OptimizerConfig:
+    type: str = "adamw"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    gradient_clipping: float = 1.0
+    # Offload optimizer state to host memory between steps (TPU HBM relief).
+    offload: bool = False
+
+
+@dataclass
+class MeshConfig:
+    """How a train engine lays its chips out as a jax.sharding.Mesh.
+
+    Normally derived from the allocation expression; explicit here for tests
+    and single-engine runs.
+    """
+
+    data_parallel_size: int = 1
+    fsdp_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.fsdp_parallel_size
+            * self.sequence_parallel_size
+            * self.tensor_parallel_size
+        )
+
+
+@dataclass
+class TrainEngineConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    path: str = ""  # HF model path or name
+    init_from_scratch: bool = False
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"  # master copy / optimizer dtype
+    disable_dropout: bool = True
+    gradient_checkpointing: bool = True
+    mb_spec: "MicroBatchSpec" = field(default_factory=lambda: MicroBatchSpec())
+    optimizer: Optional[OptimizerConfig] = field(default_factory=OptimizerConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    pad_to_maximum: bool = False
+    # Sequence-length bucketing for packed batches: powers-of-two multiples of
+    # this quantum; avoids XLA recompilation storms on variable-length data.
+    pack_length_quantum: int = 512
+    max_pack_length: int = 32768
+    attn_impl: str = "auto"  # auto | pallas_splash | xla
+    lora: "LoRAConfig" = field(default_factory=lambda: LoRAConfig())
+
+
+@dataclass
+class LoRAConfig:
+    enabled: bool = False
+    rank: int = 8
+    alpha: float = 16.0
+    target_modules: List[str] = field(
+        default_factory=lambda: ["q_proj", "k_proj", "v_proj", "o_proj"]
+    )
+
+
+@dataclass
+class MicroBatchSpec:
+    """Micro-batch splitting spec (reference: cli_args.py MicroBatchSpec)."""
+
+    n_mbs: int = 1
+    max_tokens_per_mb: int = 0  # 0 = unlimited; else balanced FFD packing
+    granularity: int = 1
+
+
+# ---------------------------------------------------------------------------
+# PPO / algorithm configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NormConfig:
+    mean_level: Optional[str] = "group"  # batch | group | none/null
+    std_level: Optional[str] = "group"
+    group_size: int = 1
+    eps: float = 1e-5
+
+
+@dataclass
+class PPOActorConfig(TrainEngineConfig):
+    group_size: int = 1  # answers per prompt (GRPO group)
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    eps_clip_higher: Optional[float] = None  # asymmetric clipping (DAPO)
+    c_clip: Optional[float] = None  # dual clip
+    temperature: float = 1.0
+    # rewards
+    group_reward_norm: bool = False
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    reward_clip: float = 20.0
+    overlong_reward_penalty: bool = False
+    overlong_tokens: int = 0
+    overlong_penalty_factor: float = 0.0
+    mask_no_eos_with_zero: bool = False
+    # KL & advantages
+    kl_ctl: float = 0.0
+    kl_estimator: str = "k1"  # k1 | k2 | k3
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: Optional[NormConfig] = field(default_factory=NormConfig)
+    # decoupled PPO
+    recompute_logprob: bool = True
+    use_decoupled_loss: bool = True
+    behav_imp_weight_cap: Optional[float] = None
+    # dynamic sampling (reject groups with identical rewards)
+    dynamic_sampling: bool = False
+    log_agent_stats: bool = False
+    log_agent_stats_keys: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PPOCriticConfig(TrainEngineConfig):
+    value_eps_clip: float = 0.2
+    ppo_n_minibatches: int = 4
+    mask_no_eos_with_zero: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Inference engine / rollout
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class InferenceEngineConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    max_concurrent_rollouts: Optional[int] = None
+    queue_size: Optional[int] = None
+    consumer_batch_size: int = 1
+    max_head_offpolicyness: int = 0  # max staleness η
+    enable_rollout_tracing: bool = False
+    check_trajectory_format: bool = False
+    schedule_policy: str = "round_robin"  # round_robin | least_requests
+    setup_timeout: float = 120.0
+    request_timeout: float = 3600.0
+    request_retries: int = 3
+    pause_grace_period: float = 0.0
+    cleanup_timeout: float = 120.0
+
+
+@dataclass
+class GenServerConfig:
+    """Config for the JAX generation server (counterpart of SGLangConfig)."""
+
+    model_path: str = ""
+    dtype: str = "bfloat16"
+    max_seqs: int = 64  # continuous-batching slots
+    prefill_chunk: int = 512
+    max_context_len: int = 8192
+    page_size: int = 128
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = pick free port
+    enable_metrics: bool = True
+    random_seed: int = 1
+    # KV cache dtype; bf16 default, fp8-style int8 quantization optional later.
+    kv_dtype: str = "bfloat16"
+
+    @staticmethod
+    def build_cmd(
+        config: "GenServerConfig",
+        host: str,
+        port: int,
+        dist_init_addr: Optional[str] = None,
+    ) -> str:
+        """Shell command launching a generation server (reference: SGLangConfig.build_cmd)."""
+        args = [
+            "python", "-m", "areal_tpu.gen.server",
+            f"--model-path={config.model_path}",
+            f"--dtype={config.dtype}",
+            f"--max-seqs={config.max_seqs}",
+            f"--max-context-len={config.max_context_len}",
+            f"--host={host}",
+            f"--port={port}",
+            f"--random-seed={config.random_seed}",
+        ]
+        if dist_init_addr:
+            args.append(f"--dist-init-addr={dist_init_addr}")
+        return " ".join(args)
+
+
+# ---------------------------------------------------------------------------
+# Infra: saver / evaluator / recover / stats / name_resolve / launcher
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TimerConfig:
+    freq_epochs: Optional[int] = None
+    freq_steps: Optional[int] = None
+    freq_secs: Optional[int] = None
+
+
+@dataclass
+class SaverConfig(TimerConfig):
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+
+
+@dataclass
+class EvaluatorConfig(TimerConfig):
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+
+
+@dataclass
+class RecoverConfig(TimerConfig):
+    mode: str = "disabled"  # disabled | auto | fault | resume
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+    retries: int = 3
+
+
+@dataclass
+class StatsLoggerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+    wandb: Dict[str, Any] = field(default_factory=dict)
+    tensorboard_dir: Optional[str] = None
+
+
+@dataclass
+class NameResolveConfig:
+    type: str = "memory"  # memory | nfs | etcd3
+    nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+    etcd3_addr: str = "localhost:2379"
+
+
+@dataclass
+class ClusterSpecConfig:
+    name_resolve: NameResolveConfig = field(default_factory=NameResolveConfig)
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu/experiments"
+    n_nodes: int = 1
+    n_accelerators_per_node: int = 8
+
+
+@dataclass
+class LauncherConfig:
+    inference_server_cpus_per_accelerator: int = 4
+    inference_server_mem_per_accelerator: int = 32768
+    trainer_cpus_per_accelerator: int = 4
+    trainer_mem_per_accelerator: int = 32768
+    inference_server_env_vars: str = ""
+    trainer_env_vars: str = ""
+    trainer_port: int = 27009
+
+
+@dataclass
+class DatasetConfig:
+    path: str = ""
+    type: str = ""
+    batch_size: int = 1
+    shuffle: bool = True
+    pin_memory: bool = False
+    num_workers: int = 2
+    drop_last: bool = True
+    max_length: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# Experiment-level configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BaseExperimentConfig:
+    experiment_name: str = "my-exp"
+    trial_name: str = "my-trial"
+    cluster: ClusterSpecConfig = field(default_factory=ClusterSpecConfig)
+    allocation_mode: str = ""
+    seed: int = 1
+    total_train_epochs: int = 1
+    total_train_steps: Optional[int] = None
+    tokenizer_path: str = ""
+    train_dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    valid_dataset: Optional[DatasetConfig] = None
+    saver: SaverConfig = field(default_factory=SaverConfig)
+    checkpointer: SaverConfig = field(default_factory=SaverConfig)
+    evaluator: EvaluatorConfig = field(default_factory=EvaluatorConfig)
+    recover: RecoverConfig = field(default_factory=RecoverConfig)
+    stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
+    launcher: LauncherConfig = field(default_factory=LauncherConfig)
+
+
+@dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class RWConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class GRPOConfig(BaseExperimentConfig):
+    async_training: bool = True
+    gconfig: GenerationHyperparameters = field(
+        default_factory=GenerationHyperparameters
+    )
+    rollout: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
+    gen_server: GenServerConfig = field(default_factory=GenServerConfig)
+    actor: PPOActorConfig = field(default_factory=PPOActorConfig)
+    ref: Optional[TrainEngineConfig] = None
+
+
+@dataclass
+class PPOConfig(GRPOConfig):
+    critic: PPOCriticConfig = field(default_factory=PPOCriticConfig)
+
+
+# ---------------------------------------------------------------------------
+# Loading: YAML + dot-list overrides (no OmegaConf)
+# ---------------------------------------------------------------------------
+
+
+def _from_dict(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
+    if data is None:
+        data = {}
+    if not isinstance(data, dict):
+        raise ValueError(f"config node {path or '<root>'} must be a mapping")
+    kwargs = {}
+    fld_map = {f.name: f for f in fields(cls)}
+    for key, value in data.items():
+        if key not in fld_map:
+            raise ValueError(f"unknown config key {path + key!r} for {cls.__name__}")
+        kwargs[key] = _coerce(fld_map[key].type, value, path + key + ".")
+    return cls(**kwargs)
+
+
+def _unwrap_optional(tp):
+    origin = get_origin(tp)
+    if origin is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0], True
+    return tp, False
+
+
+def _coerce(tp, value, path):
+    if isinstance(tp, str):
+        # string annotations from `from __future__` or forward refs
+        tp = _resolve_annotation(tp)
+    tp, optional = _unwrap_optional(tp)
+    if value is None:
+        return None
+    if is_dataclass(tp) and isinstance(value, dict):
+        return _from_dict(tp, value, path)
+    if is_dataclass(tp) and isinstance(value, tp):
+        return value
+    origin = get_origin(tp)
+    if origin in (list, List):
+        (etp,) = get_args(tp) or (Any,)
+        return [_coerce(etp, v, path) for v in value]
+    if origin in (dict, Dict):
+        return dict(value)
+    if tp is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    if tp in (int, float, str) and not isinstance(value, tp):
+        return tp(value)
+    return value
+
+
+_ANNOT_CACHE: Dict[str, Any] = {}
+
+
+def _resolve_annotation(name: str):
+    if name in _ANNOT_CACHE:
+        return _ANNOT_CACHE[name]
+    ns = dict(globals())
+    import typing
+
+    ns.update(vars(typing))
+    try:
+        tp = eval(name, ns)  # noqa: S307 — annotations from this module only
+    except Exception:
+        tp = Any
+    _ANNOT_CACHE[name] = tp
+    return tp
+
+
+def to_dict(cfg) -> Dict[str, Any]:
+    if is_dataclass(cfg):
+        return {f.name: to_dict(getattr(cfg, f.name)) for f in fields(cfg)}
+    if isinstance(cfg, list):
+        return [to_dict(v) for v in cfg]
+    if isinstance(cfg, dict):
+        return {k: to_dict(v) for k, v in cfg.items()}
+    return cfg
+
+
+def _apply_dotlist(data: Dict[str, Any], overrides: List[str]):
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} must look like a.b.c=value")
+        key, _, raw = item.partition("=")
+        node = data
+        parts = key.strip().split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+            if not isinstance(node, dict):
+                raise ValueError(f"cannot override through non-mapping at {p!r}")
+        node[parts[-1]] = yaml.safe_load(raw) if raw != "" else None
+
+
+def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
+    """Parse `--config path.yaml key=value ...` into a config dataclass.
+
+    Counterpart of the reference's `load_expr_config` (cli_args.py:1280).
+    Returns (config, config_file_path).
+    """
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default=None)
+    args, overrides = parser.parse_known_args(argv)
+    bad = [o for o in overrides if o.startswith("--")]
+    if bad:
+        raise ValueError(
+            f"unrecognized flags {bad}; overrides use dotted form a.b.c=value"
+        )
+    data: Dict[str, Any] = {}
+    if args.config:
+        with open(args.config) as f:
+            data = yaml.safe_load(f) or {}
+    _apply_dotlist(data, overrides)
+    cfg = _from_dict(config_cls, data)
+    # propagate experiment/trial names into nested configs that carry them
+    for f in fields(cfg):
+        sub = getattr(cfg, f.name)
+        if is_dataclass(sub) and hasattr(sub, "experiment_name"):
+            if getattr(sub, "experiment_name", None) in ("", None):
+                sub.experiment_name = cfg.experiment_name
+            if getattr(sub, "trial_name", None) in ("", None):
+                sub.trial_name = cfg.trial_name
+        if is_dataclass(sub) and hasattr(sub, "fileroot"):
+            if getattr(sub, "fileroot", None) in ("", None):
+                sub.fileroot = cfg.cluster.fileroot
+    return cfg, args.config or ""
+
+
+def save_config(cfg, path: str):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(to_dict(cfg), f, sort_keys=False)

@@ -1,0 +1,383 @@
+"""Padded / packed batch representations and transformations.
+
+Capability counterpart of the reference's `areal/utils/data.py` (1364 LoC:
+pad/pack/unpack, concat_padded_tensors, microbatch splitting, Normalization,
+KLEstimator).  TPU-first re-design:
+
+- Batches are plain `dict[str, np.ndarray]` (host side) — no TensorDict/torch.
+- Padded layout: every per-token key is [B, L] plus boolean "attention_mask".
+- Packed layout: per-token keys are flat [T] plus "cu_seqlens" [B+1] and
+  int32 "segment_ids" [T]; attention masking on TPU is segment-id based
+  (replaces flash-attn varlen), and packed buffers are *bucketed* to
+  power-of-two lengths so jit sees few distinct shapes.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.utils.datapack import allocate_balanced_mbs, round_up_to_bucket
+
+MbList = List[Dict[str, np.ndarray]]
+
+_NON_SEQ_KEYS = ("cu_seqlens", "max_seqlen", "segment_ids", "total_lens")
+
+
+def _is_per_token(key: str, arr: np.ndarray, batch: int, seqlen: int) -> bool:
+    return arr.ndim >= 2 and arr.shape[0] == batch and arr.shape[1] == seqlen
+
+
+# ---------------------------------------------------------------------------
+# Padded representation
+# ---------------------------------------------------------------------------
+
+
+def pad_sequences_to_tensors(
+    seqs: List[Dict[str, Any]], pad_value: float = 0.0
+) -> Dict[str, np.ndarray]:
+    """Stack a list of per-trajectory dicts (1-D arrays of varying length per
+    per-token key; scalars allowed) into a padded batch with attention_mask."""
+    if not seqs:
+        return {}
+    keys = list(seqs[0].keys())
+    token_keys = [
+        k
+        for k in keys
+        if np.asarray(seqs[0][k]).ndim >= 1 and k != "attention_mask"
+    ]
+    if not token_keys:
+        raise ValueError("trajectory dicts contain no per-token (1-D+) keys")
+    lens = []
+    for s in seqs:
+        klens = {k: len(np.asarray(s[k])) for k in token_keys}
+        if len(set(klens.values())) != 1:
+            raise ValueError(f"per-token keys disagree on length: {klens}")
+        lens.append(next(iter(klens.values())))
+    max_len = max(lens)
+    out: Dict[str, np.ndarray] = {}
+    for k in keys:
+        vals = [np.asarray(s[k]) for s in seqs]
+        if vals[0].ndim == 0:
+            out[k] = np.stack(vals)
+            continue
+        padded = []
+        for v in vals:
+            pad_width = [(0, max_len - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+            padded.append(np.pad(v, pad_width, constant_values=pad_value))
+        out[k] = np.stack(padded)
+    out["attention_mask"] = (
+        np.arange(max_len)[None, :] < np.asarray(lens)[:, None]
+    )
+    return out
+
+
+def concat_padded_tensors(
+    dicts: List[Dict[str, np.ndarray]], pad_value: float = 0.0
+) -> Dict[str, np.ndarray]:
+    """Concatenate padded batches along batch dim, re-padding to the common
+    max length (reference: data.py:152)."""
+    dicts = [d for d in dicts if d]
+    if not dicts:
+        return {}
+    assert all("attention_mask" in d for d in dicts)
+    max_len = max(d["attention_mask"].shape[1] for d in dicts)
+    keys = set(dicts[0].keys())
+    for d in dicts[1:]:
+        if set(d.keys()) != keys:
+            raise ValueError(f"inconsistent keys: {keys} vs {set(d.keys())}")
+    out: Dict[str, np.ndarray] = {}
+    for k in keys:
+        parts = []
+        for d in dicts:
+            arr = d[k]
+            B, L = d["attention_mask"].shape
+            if _is_per_token(k, arr, B, L) and L < max_len:
+                pad_width = [(0, 0), (0, max_len - L)] + [(0, 0)] * (arr.ndim - 2)
+                fill = False if arr.dtype == np.bool_ else pad_value
+                arr = np.pad(arr, pad_width, constant_values=fill)
+            parts.append(arr)
+        out[k] = np.concatenate(parts, axis=0)
+    return out
+
+
+def seq_lens(batch: Dict[str, np.ndarray]) -> np.ndarray:
+    if "attention_mask" in batch:
+        return batch["attention_mask"].astype(np.int64).sum(-1)
+    if "cu_seqlens" in batch:
+        cu = batch["cu_seqlens"]
+        return (cu[1:] - cu[:-1]).astype(np.int64)
+    raise ValueError("batch has neither attention_mask nor cu_seqlens")
+
+
+def select_rows(batch: Dict[str, np.ndarray], idx: Sequence[int]) -> Dict[str, np.ndarray]:
+    idx = np.asarray(idx, dtype=np.int64)
+    return {k: v[idx] if isinstance(v, np.ndarray) and v.ndim >= 1 else v
+            for k, v in batch.items()}
+
+
+def batch_size(batch: Dict[str, np.ndarray]) -> int:
+    if "attention_mask" in batch:
+        return batch["attention_mask"].shape[0]
+    if "cu_seqlens" in batch:
+        return len(batch["cu_seqlens"]) - 1
+    raise ValueError("cannot infer batch size")
+
+
+# ---------------------------------------------------------------------------
+# Packed representation
+# ---------------------------------------------------------------------------
+
+
+def pack_tensor_dict(
+    batch: Dict[str, np.ndarray],
+    pad_to: Optional[int] = None,
+    quantum: int = 0,
+    max_len: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Padded [B, L] -> packed flat [T] with cu_seqlens & segment_ids
+    (reference: data.py:266).
+
+    If `pad_to` or `quantum` is given, the flat buffer is right-padded to a
+    bucketed length with segment_id = -1 filler tokens, keeping XLA shapes
+    static across steps.
+    """
+    mask = batch["attention_mask"].astype(bool)
+    B, L = mask.shape
+    lens = mask.sum(-1).astype(np.int32)
+    total = int(lens.sum())
+    cu = np.zeros(B + 1, dtype=np.int32)
+    np.cumsum(lens, out=cu[1:])
+    target = total
+    if pad_to is not None:
+        target = max(pad_to, total)
+    elif quantum:
+        target = round_up_to_bucket(total, quantum, max_len)
+        if target < total:
+            raise ValueError(f"packed length {total} exceeds max bucket {target}")
+    flat_idx = np.nonzero(mask.reshape(-1))[0]
+    out: Dict[str, np.ndarray] = {}
+    token_keys = []
+    for k, arr in batch.items():
+        if k == "attention_mask":
+            continue
+        if _is_per_token(k, arr, B, L):
+            flat = arr.reshape(B * L, *arr.shape[2:])[flat_idx]
+            if target > total:
+                pad_width = [(0, target - total)] + [(0, 0)] * (flat.ndim - 1)
+                flat = np.pad(flat, pad_width)
+            out[k] = flat
+            token_keys.append(k)
+        else:
+            out[k] = arr
+    seg = np.repeat(np.arange(B, dtype=np.int32), lens)
+    if target > total:
+        seg = np.pad(seg, (0, target - total), constant_values=-1)
+    # per-token position within each sequence (for RoPE on packed data)
+    pos = np.concatenate([np.arange(n, dtype=np.int32) for n in lens]) if B else \
+        np.zeros(0, np.int32)
+    if target > total:
+        pos = np.pad(pos, (0, target - total))
+    out["segment_ids"] = seg
+    out["positions"] = pos
+    out["cu_seqlens"] = cu
+    out["max_seqlen"] = np.asarray(int(lens.max()) if B else 0, dtype=np.int32)
+    out["total_lens"] = np.asarray(total, dtype=np.int32)
+    # explicit per-token key registry — unpacking must never guess from shapes
+    out["__token_keys__"] = np.array(sorted(token_keys))
+    return out
+
+
+def unpack_sequence(packed: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+    """Packed -> list of per-sequence dicts (inverse of pack for per-token keys)."""
+    cu = packed["cu_seqlens"]
+    B = len(cu) - 1
+    out: List[Dict[str, np.ndarray]] = []
+    if "__token_keys__" in packed:
+        token_keys = set(str(k) for k in packed["__token_keys__"])
+    else:  # packed dict from an external source: fall back to shape heuristic
+        total = int(packed["total_lens"]) if "total_lens" in packed else int(cu[-1])
+        token_keys = {
+            k
+            for k, arr in packed.items()
+            if k not in _NON_SEQ_KEYS
+            and k not in ("positions", "__token_keys__")
+            and isinstance(arr, np.ndarray)
+            and arr.ndim >= 1
+            and arr.shape[0] >= max(total, B + 1)
+        }
+    for i in range(B):
+        d: Dict[str, np.ndarray] = {}
+        s, e = int(cu[i]), int(cu[i + 1])
+        for k, arr in packed.items():
+            if k in _NON_SEQ_KEYS or k in ("positions", "__token_keys__"):
+                continue
+            if k in token_keys:
+                d[k] = arr[s:e]
+            elif isinstance(arr, np.ndarray) and arr.ndim >= 1 and arr.shape[0] == B:
+                d[k] = arr[i]
+        out.append(d)
+    return out
+
+
+def pad_packed_tensor_dict(
+    packed: Dict[str, np.ndarray], target: int
+) -> Dict[str, np.ndarray]:
+    """Right-pad an existing packed dict's flat buffers to `target` tokens."""
+    total = int(packed["total_lens"])
+    if target < total:
+        raise ValueError(f"target {target} < total {total}")
+    if target == int(packed["segment_ids"].shape[0]):
+        return packed
+    out = dict(packed)
+    cur = int(packed["segment_ids"].shape[0])
+    extra = target - cur
+    token_keys = set(str(k) for k in packed.get("__token_keys__", [])) | {
+        "segment_ids",
+        "positions",
+    }
+    for k in token_keys:
+        arr = packed[k]
+        if extra < 0:  # shrink only ever removes filler (target >= total checked)
+            out[k] = arr[:target]
+        elif extra > 0:
+            pad_width = [(0, extra)] + [(0, 0)] * (arr.ndim - 1)
+            fill = -1 if k == "segment_ids" else 0
+            out[k] = np.pad(arr, pad_width, constant_values=fill)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Micro-batch splitting
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MicroBatchList:
+    mbs: MbList
+    groups: List[List[int]]  # original row indices per micro-batch
+    forward_indices: List[int]  # flattened order rows were dispatched in
+
+    def merge_outputs(self, outputs: List[np.ndarray]) -> np.ndarray:
+        """Re-assemble per-row outputs produced per-microbatch back into
+        original batch order."""
+        flat = np.concatenate(outputs, axis=0)
+        inv = np.empty(len(self.forward_indices), dtype=np.int64)
+        inv[np.asarray(self.forward_indices)] = np.arange(len(self.forward_indices))
+        return flat[inv]
+
+
+def split_padded_tensor_dict_into_mb_list(
+    batch: Dict[str, np.ndarray],
+    n_mbs: int = 1,
+    max_tokens_per_mb: Optional[int] = None,
+) -> MicroBatchList:
+    """Balanced micro-batch split of a padded batch (reference: data.py:404)."""
+    lens = seq_lens(batch)
+    groups = allocate_balanced_mbs(lens, max_tokens_per_mb, n_mbs)
+    groups = [sorted(g) for g in groups if g]
+    mbs = [select_rows(batch, g) for g in groups]
+    fwd = [i for g in groups for i in g]
+    return MicroBatchList(mbs=mbs, groups=groups, forward_indices=fwd)
+
+
+# ---------------------------------------------------------------------------
+# Normalization / KL estimators
+# ---------------------------------------------------------------------------
+
+
+class Normalization:
+    """Masked mean/std normalization at batch or group level (reference:
+    data.py:1073 `Normalization` used for advantage normalization)."""
+
+    def __init__(
+        self,
+        mean_level: Optional[str] = "batch",
+        std_level: Optional[str] = "batch",
+        group_size: int = 1,
+        eps: float = 1e-5,
+    ):
+        for lvl in (mean_level, std_level):
+            if lvl not in (None, "none", "batch", "group"):
+                raise ValueError(f"bad normalization level {lvl!r}")
+        self.mean_level = None if mean_level in (None, "none") else mean_level
+        self.std_level = None if std_level in (None, "none") else std_level
+        self.group_size = group_size
+        self.eps = eps
+
+    @staticmethod
+    def _masked_moments(x: np.ndarray, mask: np.ndarray, axis=None):
+        cnt = np.maximum(mask.sum(axis=axis, keepdims=True), 1)
+        mean = (x * mask).sum(axis=axis, keepdims=True) / cnt
+        var = (((x - mean) ** 2) * mask).sum(axis=axis, keepdims=True) / cnt
+        return mean, np.sqrt(var)
+
+    def __call__(self, x: np.ndarray, mask: Optional[np.ndarray] = None) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float32)
+        if mask is None:
+            mask = np.ones_like(x, dtype=np.float32)
+        mask = mask.astype(np.float32)
+        B = x.shape[0]
+
+        def group_view(a):
+            assert B % self.group_size == 0, (B, self.group_size)
+            return a.reshape(B // self.group_size, self.group_size, *x.shape[1:])
+
+        if self.mean_level == "batch":
+            mean, _ = self._masked_moments(x, mask)
+        elif self.mean_level == "group":
+            gm, _ = self._masked_moments(
+                group_view(x), group_view(mask), axis=tuple(range(1, x.ndim + 1))
+            )
+            mean = np.repeat(gm, self.group_size, axis=0).reshape(x.shape)
+        else:
+            mean = np.zeros_like(x)
+        centered = x - mean
+        if self.std_level == "batch":
+            _, std = self._masked_moments(x, mask)
+        elif self.std_level == "group":
+            _, gs = self._masked_moments(
+                group_view(x), group_view(mask), axis=tuple(range(1, x.ndim + 1))
+            )
+            std = np.repeat(gs, self.group_size, axis=0).reshape(x.shape)
+        else:
+            std = None
+        denom = 1.0 if std is None else std + self.eps
+        return np.where(mask > 0, centered / denom, x * 0.0)
+
+
+class KLEstimator:
+    """k1/k2/k3 KL estimators (http://joschu.net/blog/kl-approx.html;
+    reference: data.py:1306)."""
+
+    def __init__(self, kind: str = "k1", clip: float = 20.0):
+        if kind not in ("k1", "k2", "k3"):
+            raise ValueError(kind)
+        self.kind = kind
+        self.clip = clip
+
+    def __call__(self, logp: np.ndarray, ref_logp: np.ndarray) -> np.ndarray:
+        log_ratio = np.clip(logp - ref_logp, -self.clip, self.clip)
+        if self.kind == "k1":
+            return log_ratio
+        if self.kind == "k2":
+            return 0.5 * log_ratio**2
+        return np.expm1(-log_ratio) + log_ratio  # k3
+
+
+# ---------------------------------------------------------------------------
+# Misc host-side helpers
+# ---------------------------------------------------------------------------
+
+
+def to_jax(batch: Dict[str, np.ndarray], device=None):
+    import jax
+
+    return {
+        k: (jax.device_put(v, device) if isinstance(v, np.ndarray) else v)
+        for k, v in batch.items()
+    }
+
+
+def tree_bytes(batch: Dict[str, np.ndarray]) -> int:
+    return sum(v.nbytes for v in batch.values() if isinstance(v, np.ndarray))

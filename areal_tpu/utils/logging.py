@@ -1,0 +1,60 @@
+"""Logging helpers (counterpart of areal/utils/logging.py in the reference).
+
+Plain stdlib logging with an optional ANSI-colored formatter; no third-party
+colorlog dependency.
+"""
+
+import logging
+import os
+import sys
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_CONFIGURED = False
+
+_LEVEL_COLORS = {
+    logging.DEBUG: "\033[36m",  # cyan
+    logging.INFO: "\033[32m",  # green
+    logging.WARNING: "\033[33m",  # yellow
+    logging.ERROR: "\033[31m",  # red
+    logging.CRITICAL: "\033[41m",  # red background
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        color = _LEVEL_COLORS.get(record.levelno)
+        if color and sys.stderr.isatty():
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def _default_level() -> int:
+    name = os.environ.get("AREAL_LOG_LEVEL", "INFO").upper()
+    return getattr(logging, name, logging.INFO)
+
+
+def getLogger(name: Optional[str] = None) -> logging.Logger:
+    global _CONFIGURED
+    with _LOCK:
+        if not _CONFIGURED:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(
+                _ColorFormatter(
+                    fmt="%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s",
+                    datefmt="%Y%m%d-%H:%M:%S",
+                )
+            )
+            root = logging.getLogger("areal_tpu")
+            root.addHandler(handler)
+            root.setLevel(_default_level())
+            root.propagate = False
+            _CONFIGURED = True
+    full = f"areal_tpu.{name}" if name else "areal_tpu"
+    return logging.getLogger(full)
+
+
+getlogger = getLogger

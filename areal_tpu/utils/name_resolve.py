@@ -1,0 +1,340 @@
+"""Distributed key-value rendezvous ("name resolve").
+
+Capability counterpart of the reference's `areal/utils/name_resolve.py` (1252
+LoC: memory/NFS/etcd3/ray backends, watcher threads, delete_on_exit GC).  Two
+backends here — in-process memory (tests, single-host) and NFS (a shared
+filesystem is the natural multi-host rendezvous on TPU pods; every key is a
+file).  The etcd3 client is not in this image, so the etcd backend is a stub
+that raises with a clear message.
+"""
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.api.config import NameResolveConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameRecordRepository(ABC):
+    @abstractmethod
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: Optional[float] = None,
+        replace: bool = False,
+    ): ...
+
+    @abstractmethod
+    def get(self, name: str) -> str: ...
+
+    @abstractmethod
+    def get_subtree(self, name_root: str) -> List[str]: ...
+
+    @abstractmethod
+    def find_subtree(self, name_root: str) -> List[str]: ...
+
+    @abstractmethod
+    def delete(self, name: str): ...
+
+    @abstractmethod
+    def clear_subtree(self, name_root: str): ...
+
+    @abstractmethod
+    def reset(self): ...
+
+    # --- shared conveniences ---
+    def add_subentry(self, name: str, value: str, **kwargs) -> str:
+        sub = f"{name}/{uuid.uuid4().hex[:8]}"
+        self.add(sub, value, **kwargs)
+        return sub
+
+    def wait(
+        self,
+        name: str,
+        timeout: Optional[float] = None,
+        poll_frequency: float = 0.1,
+    ) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"name_resolve.wait({name!r}) timed out")
+                time.sleep(poll_frequency)
+
+    def watch_names(
+        self,
+        names: List[str],
+        call_back: Callable[[], None],
+        poll_frequency: float = 2.0,
+        wait_timeout: float = 300.0,
+    ) -> threading.Thread:
+        """Fire `call_back` once any watched name disappears (reference:
+        name_resolve.py:141-181 — used for peer-death detection)."""
+
+        def _watch():
+            try:
+                for n in names:
+                    self.wait(n, timeout=wait_timeout, poll_frequency=poll_frequency)
+            except TimeoutError:
+                # a peer that never registered is as dead as one that vanished
+                logger.warning(
+                    f"watched names {names} did not appear within "
+                    f"{wait_timeout}s; treating peer as dead"
+                )
+                call_back()
+                return
+            while True:
+                try:
+                    for n in names:
+                        self.get(n)
+                except NameEntryNotFoundError:
+                    call_back()
+                    return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+        return t
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    """Process-local dict; the default for unit tests and single-process runs."""
+
+    def __init__(self):
+        self._store: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def get_subtree(self, name_root):
+        prefix = name_root.rstrip("/") + "/"
+        with self._lock:
+            return [
+                v
+                for k, v in sorted(self._store.items())
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            ]
+
+    def find_subtree(self, name_root):
+        prefix = name_root.rstrip("/") + "/"
+        with self._lock:
+            return sorted(
+                k
+                for k in self._store
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            )
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+
+    def clear_subtree(self, name_root):
+        prefix = name_root.rstrip("/") + "/"
+        with self._lock:
+            for k in [
+                k
+                for k in self._store
+                if k.startswith(prefix) or k == name_root.rstrip("/")
+            ]:
+                del self._store[k]
+
+    def reset(self):
+        with self._lock:
+            self._store.clear()
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """Every key is a file under `record_root` on a shared filesystem.
+
+    Works on any POSIX shared mount (NFS/GCSfuse/Lustre); atomicity via
+    write-to-temp + rename (reference: name_resolve.py:282-410).
+    """
+
+    def __init__(self, record_root: str = "/tmp/areal_tpu/name_resolve"):
+        self.record_root = record_root
+        self._to_delete: List[str] = []
+        os.makedirs(record_root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.record_root, name.strip("/"), "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        path = self._path(name)
+        if os.path.exists(path) and not replace:
+            raise NameEntryExistsError(name)
+        # retry once: a concurrent delete() may prune our freshly-made dir
+        for attempt in range(2):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            try:
+                fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+                break
+            except FileNotFoundError:
+                if attempt == 1:
+                    raise
+        with os.fdopen(fd, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, path)
+        if delete_on_exit:
+            self._to_delete.append(name)
+
+    def get(self, name):
+        path = self._path(name)
+        # Retry around NFS rename visibility races.
+        for _ in range(2):
+            try:
+                with open(path) as f:
+                    return f.read()
+            except FileNotFoundError:
+                time.sleep(0.005)
+        raise NameEntryNotFoundError(name)
+
+    def _walk(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        if not os.path.isdir(root):
+            return []
+        found = []
+        for dirpath, _, filenames in os.walk(root):
+            if "ENTRY" in filenames:
+                rel = os.path.relpath(dirpath, self.record_root)
+                found.append(rel.replace(os.sep, "/"))
+        return sorted(found)
+
+    def get_subtree(self, name_root):
+        out = []
+        for key in self._walk(name_root):
+            try:
+                out.append(self.get(key))
+            except NameEntryNotFoundError:
+                pass
+        return out
+
+    def find_subtree(self, name_root):
+        return self._walk(name_root)
+
+    def delete(self, name):
+        path = self._path(name)
+        if not os.path.exists(path):
+            raise NameEntryNotFoundError(name)
+        os.unlink(path)
+        # best-effort prune of now-empty dirs; a concurrent add() may be
+        # racing us between its makedirs and file write, so rmdir failures
+        # (or listdir on a dir another process just removed) just stop the walk
+        d = os.path.dirname(path)
+        while d != self.record_root:
+            try:
+                if os.listdir(d):
+                    break
+                os.rmdir(d)
+            except OSError:
+                break
+            d = os.path.dirname(d)
+
+    def clear_subtree(self, name_root):
+        root = os.path.join(self.record_root, name_root.strip("/"))
+        if os.path.isdir(root):
+            shutil.rmtree(root, ignore_errors=True)
+
+    def reset(self):
+        for name in list(self._to_delete):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._to_delete.clear()
+
+
+# --- module-level singleton, mirroring the reference's module API ---
+DEFAULT_REPOSITORY: NameRecordRepository = MemoryNameRecordRepository()
+
+
+def reconfigure(config: NameResolveConfig):
+    global DEFAULT_REPOSITORY
+    if config.type == "memory":
+        DEFAULT_REPOSITORY = MemoryNameRecordRepository()
+    elif config.type == "nfs":
+        DEFAULT_REPOSITORY = NfsNameRecordRepository(config.nfs_record_root)
+    elif config.type == "etcd3":
+        raise NotImplementedError(
+            "etcd3 client is not available in this environment; "
+            "use type='nfs' on a shared filesystem instead"
+        )
+    else:
+        raise ValueError(f"unknown name_resolve backend {config.type!r}")
+
+
+def add(name, value, **kwargs):
+    return DEFAULT_REPOSITORY.add(name, value, **kwargs)
+
+
+def add_subentry(name, value, **kwargs):
+    return DEFAULT_REPOSITORY.add_subentry(name, value, **kwargs)
+
+
+def get(name):
+    return DEFAULT_REPOSITORY.get(name)
+
+
+def get_subtree(name_root):
+    return DEFAULT_REPOSITORY.get_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return DEFAULT_REPOSITORY.find_subtree(name_root)
+
+
+def wait(name, **kwargs):
+    return DEFAULT_REPOSITORY.wait(name, **kwargs)
+
+
+def delete(name):
+    return DEFAULT_REPOSITORY.delete(name)
+
+
+def clear_subtree(name_root):
+    return DEFAULT_REPOSITORY.clear_subtree(name_root)
+
+
+def watch_names(names, call_back, **kwargs):
+    if isinstance(names, str):
+        names = [names]
+    return DEFAULT_REPOSITORY.watch_names(names, call_back, **kwargs)
+
+
+def reset():
+    return DEFAULT_REPOSITORY.reset()

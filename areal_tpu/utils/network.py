@@ -1,0 +1,45 @@
+"""Host/network helpers (reference: areal/utils/network.py)."""
+
+import socket
+from contextlib import closing
+from typing import List
+
+
+def gethostname() -> str:
+    return socket.gethostname()
+
+
+def gethostip() -> str:
+    try:
+        # A UDP "connection" never sends packets; it just selects the outbound
+        # interface so we learn our routable address.
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def find_free_ports(count: int, low: int = 1024, high: int = 65535) -> List[int]:
+    """Reserve `count` distinct currently-free TCP ports."""
+    ports: List[int] = []
+    socks = []
+    try:
+        while len(ports) < count:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            if low <= port <= high and port not in ports:
+                ports.append(port)
+                socks.append(s)
+            else:
+                s.close()
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def find_free_port(**kw) -> int:
+    return find_free_ports(1, **kw)[0]

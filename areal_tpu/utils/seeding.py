@@ -1,0 +1,50 @@
+"""Deterministic seeding across python/numpy/jax (reference: areal/utils/seeding.py).
+
+JAX is functional — the important artifact is the root `jax.random.key` derived
+here; stateful numpy/python seeding only covers host-side shuffling code.
+"""
+
+import hashlib
+import random
+from typing import Optional
+
+import numpy as np
+
+_BASE_SEED: Optional[int] = None
+_EXPR_NAME = ""
+_TRIAL_NAME = ""
+
+
+def _fold(seed: int, *keys: str) -> int:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(str(seed).encode())
+    for k in keys:
+        h.update(b"\x00" + k.encode())
+    return int.from_bytes(h.digest(), "little") % (2**31 - 1)
+
+
+def set_random_seed(base_seed: int, key: str = "") -> int:
+    """Seed python & numpy with a value derived from (base_seed, key).
+
+    Different `key`s (e.g. worker identities) get decorrelated streams from the
+    same base seed, mirroring the reference's per-worker seeding.
+    """
+    global _BASE_SEED
+    _BASE_SEED = base_seed
+    seed = _fold(base_seed, key)
+    random.seed(seed)
+    np.random.seed(seed % (2**32 - 1))
+    return seed
+
+
+def get_seed() -> int:
+    if _BASE_SEED is None:
+        raise RuntimeError("set_random_seed() has not been called")
+    return _BASE_SEED
+
+
+def jax_root_key(key: str = ""):
+    """Root jax PRNG key for a named stream, derived from the base seed."""
+    import jax
+
+    return jax.random.key(_fold(get_seed(), "jax", key))

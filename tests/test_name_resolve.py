@@ -1,0 +1,93 @@
+import threading
+import time
+
+import pytest
+
+from areal_tpu.utils import name_resolve, names
+from areal_tpu.utils.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        return MemoryNameRecordRepository()
+    return NfsNameRecordRepository(str(tmp_path / "nr"))
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "a")
+    repo.add("root/x/2", "b")
+    repo.add("root/y", "c")
+    assert sorted(repo.get_subtree("root/x")) == ["a", "b"]
+    assert repo.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    repo.clear_subtree("root")
+    assert repo.get_subtree("root") == []
+
+
+def test_add_subentry(repo):
+    k1 = repo.add_subentry("servers", "addr1")
+    k2 = repo.add_subentry("servers", "addr2")
+    assert k1 != k2
+    assert sorted(repo.get_subtree("servers")) == ["addr1", "addr2"]
+
+
+def test_wait_blocks_until_added(repo):
+    def adder():
+        time.sleep(0.2)
+        repo.add("late/key", "done")
+
+    t = threading.Thread(target=adder)
+    t.start()
+    assert repo.wait("late/key", timeout=5, poll_frequency=0.02) == "done"
+    t.join()
+    with pytest.raises(TimeoutError):
+        repo.wait("never", timeout=0.2, poll_frequency=0.05)
+
+
+def test_watch_names_fires_on_delete(repo):
+    repo.add("watched/a", "1")
+    fired = threading.Event()
+    repo.watch_names(["watched/a"], fired.set, poll_frequency=0.02, wait_timeout=1)
+    time.sleep(0.1)
+    assert not fired.is_set()
+    repo.delete("watched/a")
+    assert fired.wait(timeout=2)
+
+
+def test_module_level_api():
+    name_resolve.add(names.gen_server("e", "t", "0"), "addr:1234")
+    assert name_resolve.get_subtree(names.gen_servers("e", "t")) == ["addr:1234"]
+
+
+def test_nfs_reset_removes_own_entries(tmp_path):
+    repo = NfsNameRecordRepository(str(tmp_path / "nr"))
+    repo.add("a/1", "x", delete_on_exit=True)
+    repo.add("a/2", "y", delete_on_exit=False)
+    repo.reset()
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/1")
+    assert repo.get("a/2") == "y"
+
+
+def test_watch_names_fires_when_peer_never_appears():
+    repo = MemoryNameRecordRepository()
+    fired = threading.Event()
+    repo.watch_names(["never/appears"], fired.set, poll_frequency=0.02, wait_timeout=0.1)
+    assert fired.wait(timeout=2)

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.stats import ReduceType, StatsTracker
+
+
+def test_masked_avg_and_sum():
+    t = StatsTracker("ppo")
+    mask = np.array([True, True, False, True])
+    t.denominator(valid=mask)
+    t.stat(denominator="valid", loss=np.array([1.0, 2.0, 100.0, 3.0]))
+    t.stat(
+        denominator="valid",
+        reduce_type=ReduceType.SUM,
+        n_tokens=np.array([1.0, 1.0, 1.0, 1.0]),
+    )
+    out = t.export()
+    assert out["ppo/loss"] == pytest.approx(2.0)
+    assert out["ppo/n_tokens"] == pytest.approx(3.0)
+    assert out["ppo/valid/count"] == 3.0
+
+
+def test_scopes_nest():
+    t = StatsTracker()
+    with t.scope("actor"):
+        with t.scope("mb0"):
+            t.scalar(lr=0.1)
+    out = t.export()
+    assert out["actor/mb0/lr"] == pytest.approx(0.1)
+
+
+def test_min_max_reduce():
+    t = StatsTracker()
+    m = np.ones(3, dtype=bool)
+    t.denominator(all=m)
+    t.stat(denominator="all", reduce_type=ReduceType.MAX, v=np.array([1.0, 5.0, 3.0]))
+    t.denominator(all2=m)
+    t.stat(denominator="all2", reduce_type=ReduceType.MIN, w=np.array([1.0, 5.0, 3.0]))
+    out = t.export()
+    assert out["v"] == 5.0
+    assert out["w"] == 1.0
+
+
+def test_export_resets():
+    t = StatsTracker()
+    t.scalar(x=1.0)
+    assert "x" in t.export()
+    assert "x" not in t.export()
+
+
+def test_export_key_filter():
+    t = StatsTracker()
+    t.scalar(**{"a": 1.0})
+    with t.scope("keep"):
+        t.scalar(b=2.0)
+    out = t.export(key="keep")
+    assert "keep/b" in out and "a" not in out
+    # unexported keys survive
+    assert "a" in t.export()
+
+
+def test_multiple_records_accumulate():
+    t = StatsTracker()
+    for v in ([1.0, 2.0], [3.0, 4.0]):
+        arr = np.array(v)
+        t.denominator(d=np.ones(2, dtype=bool))
+        t.stat(denominator="d", x=arr)
+    assert t.export()["x"] == pytest.approx(2.5)
+
+
+def test_timing():
+    t = StatsTracker()
+    with t.record_timing("step"):
+        pass
+    out = t.export()
+    assert "time_perf/step" in out
+
+
+def test_shape_mismatch_raises():
+    t = StatsTracker()
+    t.denominator(d=np.ones(2, dtype=bool))
+    with pytest.raises(ValueError):
+        t.stat(denominator="d", x=np.ones(3))
+    with pytest.raises(ValueError):
+        t.denominator(bad=np.ones(2, dtype=np.float32))
+    with pytest.raises(ValueError):
+        t.stat(denominator="missing", x=np.ones(2))
+
+
+def test_repeated_stats_against_one_denominator():
+    # two stat() calls after one denominator(): both must count
+    t = StatsTracker()
+    t.denominator(d=np.ones(2, dtype=bool))
+    t.stat(denominator="d", loss=np.array([1.0, 1.0]))
+    t.stat(denominator="d", loss=np.array([3.0, 3.0]))
+    assert t.export()["loss"] == pytest.approx(2.0)
