@@ -134,11 +134,17 @@ class ParallelStrategy:
             raise InvalidAllocationModeError(
                 "s (Ulysses) and c (ring) both shard the sequence; pick one"
             )
+
+    def validate_folded_experts(self):
+        """For a *plain* (non-hybrid) section, expert axes fold into the dense
+        world: ep*etp must divide it.  Hybrid sections instead treat ep/etp as
+        chip axes of the ffn half (HybridTrainStrategy checks chip counts)."""
         if self.expert_parallel_size > 1:
             emp = self.expert_parallel_size * self.expert_tensor_parallel_size
             if self.world_size % emp != 0:
                 raise InvalidAllocationModeError(
-                    f"expert parallel size {emp} must divide world size {self.world_size}"
+                    f"expert parallel size {emp} must divide world size "
+                    f"{self.world_size}"
                 )
 
     def __str__(self) -> str:
@@ -391,6 +397,7 @@ class _Parser:
                     type_=AllocationType.LLM_SERVER_ONLY, gen=strat, gen_backend="jax"
                 )
             # bare dims -> train-only colocate (SFT-style)
+            strat.validate_folded_experts()
             return AllocationMode(
                 type_=AllocationType.COLOCATE,
                 train=strat,
@@ -416,6 +423,11 @@ class _Parser:
                 f"({'/'.join(GEN_BACKENDS)}): {self.text!r}"
             )
         self._check_gen(s1)
+        if b2 is not None and b2 not in TRAIN_BACKENDS:
+            raise InvalidAllocationModeError(
+                f"second section backend must be a train backend "
+                f"({'/'.join(TRAIN_BACKENDS)}), got {b2!r}"
+            )
         type_ = (
             AllocationType.DECOUPLED_TRAIN if sep == "+" else AllocationType.COLOCATE
         )
@@ -423,6 +435,7 @@ class _Parser:
         if k2 == "hybrid":
             mode.train_hybrid = s2
         else:
+            s2.validate_folded_experts()
             mode.train = s2
         mode.train_backend = b2 or "jax"
         return mode

@@ -509,6 +509,7 @@ def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
 
 
 def save_config(cfg, path: str):
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if os.path.dirname(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
         yaml.safe_dump(to_dict(cfg), f, sort_keys=False)

@@ -1,0 +1,189 @@
+"""Abstract engine interfaces.
+
+Capability counterpart of the reference's `areal/api/engine_api.py`
+(`TrainEngine` :40, `InferenceEngine` :347).  TPU-first differences:
+
+- `TrainEngine` owns a `jax.sharding.Mesh` instead of torch process groups;
+  "process group creation" becomes mesh construction, and distributed state
+  lives in sharded jax arrays.
+- Batches are host-side `dict[str, np.ndarray]` (padded or packed layout from
+  `areal_tpu.utils.data`), not torch TensorDicts.
+- `train_batch/forward` take a loss function over (logits, batch) pytrees that
+  is jit-compiled by the engine.
+"""
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.io_struct import (
+    FinetuneSpec,
+    ModelRequest,
+    ModelResponse,
+    SaveLoadMeta,
+    WeightUpdateMeta,
+)
+from areal_tpu.api.workflow import RolloutWorkflow
+
+
+@dataclass
+class Scheduling:
+    """Resource requirements of an engine worker (reference: engine_api.py:24)."""
+
+    cpu: int = 4
+    mem: int = 32768
+    accelerator: int = 1
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+
+class TrainEngine(abc.ABC):
+    """SPMD training backend over a device mesh."""
+
+    def create_process_group(self, alloc_mode=None) -> None:
+        """Build the device mesh / distributed runtime (idempotent)."""
+
+    @abc.abstractmethod
+    def initialize(
+        self,
+        addr: Optional[str] = None,
+        ft_spec: Optional[FinetuneSpec] = None,
+    ) -> None:
+        """Load the model, build optimizer state, compile step functions."""
+
+    def destroy(self) -> None:
+        """Release device memory and host resources."""
+
+    @property
+    def data_parallel_rank(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def data_parallel_world_size(self) -> int:
+        raise NotImplementedError
+
+    def is_data_parallel_head(self) -> bool:
+        raise NotImplementedError
+
+    def current_data_parallel_head(self) -> int:
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def train_batch(
+        self,
+        input_: Dict[str, np.ndarray],
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> Dict[str, float]:
+        """One optimizer step over micro-batches with grad accumulation.
+
+        `loss_weight_fn(batch) -> float` returns each micro-batch's weight
+        (e.g. token count); losses are globally normalized by the total weight
+        across all micro-batches and dp ranks (reference: fsdp_engine.py:499).
+        """
+
+    @abc.abstractmethod
+    def forward(
+        self,
+        input_: Dict[str, np.ndarray],
+        output_key: str = "logprobs",
+        post_hook: Optional[Callable] = None,
+        aggregate_fn: Callable = None,
+    ) -> Any:
+        """No-grad forward over micro-batches, outputs re-assembled to input
+        order."""
+
+    def eval_batch(
+        self,
+        input_: Dict[str, np.ndarray],
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> Dict[str, float]:
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update_weights(self, meta: WeightUpdateMeta) -> None:
+        """Push current weights to inference servers (disk or transfer path)."""
+
+    @abc.abstractmethod
+    def save(self, meta: SaveLoadMeta) -> None: ...
+
+    @abc.abstractmethod
+    def load(self, meta: SaveLoadMeta) -> None: ...
+
+    def step_lr_scheduler(self) -> None:
+        """Advance the LR schedule one step (called once per train iteration)."""
+
+    def get_scheduling_config(self) -> Scheduling:
+        return Scheduling()
+
+    def set_version(self, version: int) -> None:
+        raise NotImplementedError
+
+    def get_version(self) -> int:
+        raise NotImplementedError
+
+
+class InferenceEngine(abc.ABC):
+    """Client of a fleet of streaming-LLM servers (reference: engine_api.py:347)."""
+
+    def initialize(
+        self,
+        addr: Optional[str] = None,
+        train_data_parallel_size: Optional[int] = None,
+    ) -> None: ...
+
+    def destroy(self) -> None: ...
+
+    @abc.abstractmethod
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        """Asynchronously generate one completion (n_samples == 1)."""
+
+    # --- rollout submission surface ---
+    @abc.abstractmethod
+    def submit(
+        self,
+        data: Dict[str, Any],
+        workflow: Optional[RolloutWorkflow] = None,
+        workflow_builder: Optional[Callable] = None,
+        should_accept: Optional[Callable] = None,
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def wait(self, count: int, timeout: Optional[float] = None) -> Dict[str, Any]: ...
+
+    def rollout_batch(
+        self,
+        data: List[Dict[str, Any]],
+        workflow: Optional[RolloutWorkflow] = None,
+        workflow_builder: Optional[Callable] = None,
+        should_accept: Optional[Callable] = None,
+    ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow: Optional[RolloutWorkflow] = None,
+        workflow_builder: Optional[Callable] = None,
+        should_accept: Optional[Callable] = None,
+    ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # --- weight updates & versioning ---
+    def init_weight_update_group(self, meta: WeightUpdateMeta) -> None: ...
+
+    @abc.abstractmethod
+    def update_weights(self, meta: WeightUpdateMeta) -> None: ...
+
+    @abc.abstractmethod
+    def set_version(self, version: int) -> None: ...
+
+    @abc.abstractmethod
+    def get_version(self) -> int: ...
+
+    def pause(self) -> None:
+        """Pause new request submission (during weight updates)."""
+
+    def resume(self) -> None: ...

@@ -1,0 +1,29 @@
+"""Agent environment interface (reference: areal/api/env_api.py:5)."""
+
+import abc
+from typing import Any, Dict, List, Tuple
+
+
+class Environment(abc.ABC):
+    """Tool-providing environment for agentic rollouts."""
+
+    async def ainitialize(self) -> None: ...
+
+    async def aclose(self) -> None: ...
+
+    @abc.abstractmethod
+    def list_tools(self) -> List[Dict[str, Any]]:
+        """JSON-schema tool descriptions exposed to the policy."""
+
+    @abc.abstractmethod
+    async def aexecute_tool(
+        self, tool_name: str, arguments: Dict[str, Any]
+    ) -> Tuple[Any, float, bool]:
+        """Execute a tool; returns (observation, reward, done)."""
+
+    async def __aenter__(self):
+        await self.ainitialize()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.aclose()

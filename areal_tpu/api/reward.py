@@ -1,0 +1,96 @@
+"""Reward computation off the event loop.
+
+Behavioral counterpart of the reference's `AsyncRewardWrapper`
+(areal/api/reward_api.py:37): reward functions (sympy math verification,
+sandboxed code execution) are CPU-heavy and must not block the rollout event
+loop, so they run in a shared ProcessPoolExecutor with timeout, retry, and
+automatic pool reconstruction when a worker dies.
+"""
+
+import asyncio
+import multiprocessing
+import threading
+from concurrent.futures import BrokenExecutor, ProcessPoolExecutor
+from typing import Callable, Optional
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("reward")
+
+REWARD_TIMEOUT_SECONDS = 15.0
+_MAX_WORKERS = 4
+
+_pool_lock = threading.Lock()
+_pool: Optional[ProcessPoolExecutor] = None
+
+
+def _new_pool() -> ProcessPoolExecutor:
+    # spawn, not fork: the parent runs JAX (multithreaded) and an asyncio
+    # loop; forking either risks deadlock
+    return ProcessPoolExecutor(
+        max_workers=_MAX_WORKERS, mp_context=multiprocessing.get_context("spawn")
+    )
+
+
+def _get_pool() -> ProcessPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = _new_pool()
+        return _pool
+
+
+def _recreate_pool():
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown(wait=False, cancel_futures=True)
+        _pool = _new_pool()
+        return _pool
+
+
+class AsyncRewardWrapper:
+    """Wraps a sync `reward_fn(...) -> float` as `await wrapper(...)`."""
+
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        timeout: float = REWARD_TIMEOUT_SECONDS,
+        max_retries: int = 2,
+    ):
+        self.reward_fn = reward_fn
+        self.timeout = timeout
+        self.max_retries = max_retries
+
+    async def __call__(self, *args, **kwargs) -> float:
+        loop = asyncio.get_running_loop()
+        for attempt in range(self.max_retries):
+            pool = _get_pool()
+            try:
+                fut = pool.submit(self.reward_fn, *args, **kwargs)
+                return float(
+                    await asyncio.wait_for(
+                        asyncio.wrap_future(fut, loop=loop), timeout=self.timeout
+                    )
+                )
+            except asyncio.TimeoutError:
+                fut.cancel()
+                logger.warning(
+                    f"reward fn timed out after {self.timeout}s "
+                    f"(attempt {attempt + 1}/{self.max_retries})"
+                )
+            except BrokenExecutor:
+                logger.warning("reward process pool broke; recreating")
+                _recreate_pool()
+            except Exception as e:  # noqa: BLE001 — a bad reward is reward 0
+                logger.warning(f"reward fn raised {e!r}; returning 0")
+                return 0.0
+        return 0.0
+
+
+def shutdown_reward_pool():
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown(wait=False, cancel_futures=True)
+            _pool = None

@@ -1,0 +1,20 @@
+"""Rollout workflow interface (reference: areal/api/workflow_api.py:11)."""
+
+import abc
+from typing import TYPE_CHECKING, Any, Dict, Optional
+
+if TYPE_CHECKING:
+    from areal_tpu.api.engine import InferenceEngine
+
+
+class RolloutWorkflow(abc.ABC):
+    @abc.abstractmethod
+    async def arun_episode(
+        self, engine: "InferenceEngine", data: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Run one episode; return a padded tensor dict (see
+        areal_tpu.utils.data.pad_sequences_to_tensors) or None to reject.
+
+        May issue several `engine.agenerate` calls concurrently (e.g. GRPO
+        groups, multi-turn conversations, agentic tool loops).
+        """

@@ -1,0 +1,296 @@
+"""HTTP client core for remote generation servers.
+
+Behavioral counterpart of the reference's `RemoteInfEngine`
+(areal/core/remote_inf_engine.py:192) and `RemoteInfBackendProtocol` (:40):
+
+- server discovery: explicit addrs -> name_resolve -> AREAL_LLM_SERVER_ADDRS
+  env (remote_inf_engine.py:254-307);
+- round-robin / least-inflight scheduling with rid->server affinity for KV
+  reuse (:339, :404-413);
+- the **interruption loop**: when a server aborts generation for a weight
+  update, the client re-submits the request with all accumulated tokens as
+  the new prompt and records per-token weight versions — the raw signal for
+  decoupled PPO (:428-478);
+- weight-update fan-out to every server over HTTP (the reference needs a
+  ProcessPoolExecutor to bypass NCCL/GIL issues; the TPU path is pure HTTP
+  + filesystem, so plain async fan-out suffices).
+"""
+
+import asyncio
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Protocol
+
+import aiohttp
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.engine import InferenceEngine
+from areal_tpu.api.io_struct import (
+    HttpGenerationResult,
+    HttpRequest,
+    ModelRequest,
+    ModelResponse,
+    WeightUpdateMeta,
+    WeightUpdateRequests,
+)
+from areal_tpu.api.workflow import RolloutWorkflow
+from areal_tpu.core.executor import WorkflowExecutor
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry, get_default_connector
+
+logger = logging.getLogger("remote_engine")
+
+RID_CACHE_SIZE = 128
+
+
+class RemoteInfBackendProtocol(Protocol):
+    """Builds/parses the HTTP wire format of a server family."""
+
+    def build_generation_request(self, req: ModelRequest) -> HttpRequest: ...
+
+    def parse_generation_response(
+        self, resp: Dict[str, Any]
+    ) -> HttpGenerationResult: ...
+
+    def build_pause_request(self) -> HttpRequest: ...
+
+    def build_resume_request(self) -> HttpRequest: ...
+
+    def build_weight_update_requests(
+        self, meta: WeightUpdateMeta
+    ) -> WeightUpdateRequests: ...
+
+
+class RemoteInfEngine(InferenceEngine):
+    """Client of N generation servers; owns the WorkflowExecutor."""
+
+    def __init__(self, config: InferenceEngineConfig, backend: RemoteInfBackendProtocol):
+        self.config = config
+        self.backend = backend
+        self.addresses: List[str] = []
+        self._server_idx = 0
+        self._version = 0
+        self._lock = threading.Lock()
+        self._rid_to_addr: "OrderedDict[str, str]" = OrderedDict()
+        self._inflight: Dict[str, int] = {}
+        self.executor = WorkflowExecutor(config, inference_engine=self)
+
+    # --- lifecycle / discovery ---
+    def initialize(
+        self,
+        addr: Optional[str | List[str]] = None,
+        train_data_parallel_size: Optional[int] = None,
+    ):
+        if addr:
+            self.addresses = [addr] if isinstance(addr, str) else list(addr)
+        else:
+            self.addresses = self._discover_servers()
+        if not self.addresses:
+            raise RuntimeError("no generation servers found")
+        self._inflight = {a: 0 for a in self.addresses}
+        logger.info(f"remote engine using servers: {self.addresses}")
+        self.executor.initialize()
+
+    def _discover_servers(self) -> List[str]:
+        env = os.environ.get("AREAL_LLM_SERVER_ADDRS")
+        if env:
+            return env.split(",")
+        key = names.gen_servers(self.config.experiment_name, self.config.trial_name)
+        deadline = time.monotonic() + self.config.setup_timeout
+        while time.monotonic() < deadline:
+            found = name_resolve.get_subtree(key)
+            if found:
+                return sorted(found)
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"no generation servers registered under {key} within "
+            f"{self.config.setup_timeout}s"
+        )
+
+    def destroy(self):
+        self.executor.destroy()
+
+    # --- versioning ---
+    def set_version(self, version: int):
+        with self._lock:
+            self._version = version
+
+    def get_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # --- scheduling ---
+    def choose_server(self) -> str:
+        with self._lock:
+            if self.config.schedule_policy == "least_requests":
+                return min(self.addresses, key=lambda a: self._inflight.get(a, 0))
+            addr = self.addresses[self._server_idx % len(self.addresses)]
+            self._server_idx += 1
+            return addr
+
+    def _server_for_rid(self, rid: str) -> str:
+        with self._lock:
+            if rid in self._rid_to_addr:
+                self._rid_to_addr.move_to_end(rid)
+                return self._rid_to_addr[rid]
+        addr = self.choose_server()
+        with self._lock:
+            if len(self._rid_to_addr) >= RID_CACHE_SIZE:
+                self._rid_to_addr.popitem(last=False)
+            self._rid_to_addr[rid] = addr
+        return addr
+
+    # --- generation with interruption loop ---
+    async def agenerate(self, req: ModelRequest) -> ModelResponse:
+        req = req.copy()
+        gconfig = req.gconfig
+        if gconfig.n_samples != 1:
+            raise ValueError(
+                "agenerate handles a single sample; issue n_samples calls"
+            )
+        max_new = gconfig.max_new_tokens
+        if max_new <= 0:
+            raise RuntimeError(f"max_new_tokens={max_new} must be positive")
+
+        addr = self._server_for_rid(req.rid)
+        start = time.perf_counter()
+        out_tokens: List[int] = []
+        out_logprobs: List[float] = []
+        out_versions: List[int] = []
+        input_len = len(req.input_ids)
+        stop_reason = None
+        ttft = float("inf")
+
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(
+                total=self.config.request_timeout,
+                sock_connect=min(60.0, self.config.request_timeout),
+            ),
+            read_bufsize=10 * 1024 * 1024,
+            connector=get_default_connector(),
+        ) as session:
+            while (
+                stop_reason not in ("stop", "length", "tool_calls")
+                and len(out_tokens) < max_new
+            ):
+                # back off while the client is paused for a weight update
+                while self.executor.is_paused():
+                    await asyncio.sleep(0.25)
+                http_req = self.backend.build_generation_request(req)
+                with self._lock:
+                    self._inflight[addr] = self._inflight.get(addr, 0) + 1
+                try:
+                    raw = await arequest_with_retry(
+                        addr=addr,
+                        endpoint=http_req.endpoint,
+                        payload=http_req.payload,
+                        method=http_req.method,
+                        max_retries=self.config.request_retries,
+                        timeout=self.config.request_timeout,
+                        session=session,
+                    )
+                finally:
+                    with self._lock:
+                        self._inflight[addr] = self._inflight.get(addr, 1) - 1
+                result = self.backend.parse_generation_response(raw)
+                stop_reason = result.stop_reason
+                version = (
+                    result.version if result.version >= 0 else self.get_version()
+                )
+                if ttft == float("inf") and result.output_tokens:
+                    ttft = time.perf_counter() - start
+                out_tokens.extend(result.output_tokens)
+                out_logprobs.extend(result.output_logprobs)
+                out_versions.extend([version] * len(result.output_tokens))
+                # interruption: resume with accumulated tokens as prompt
+                req.input_ids = req.input_ids + result.output_tokens
+                req.gconfig = req.gconfig.new(
+                    max_new_tokens=max_new - len(out_tokens)
+                )
+        if stop_reason == "abort" or stop_reason == "interrupt":
+            stop_reason = "length"  # exited loop on budget during interruption
+        return ModelResponse(
+            input_tokens=req.input_ids[:input_len],
+            output_tokens=out_tokens,
+            output_logprobs=out_logprobs,
+            output_versions=out_versions,
+            stop_reason=stop_reason or "length",
+            tokenizer=req.tokenizer,
+            latency=time.perf_counter() - start,
+            ttft=ttft,
+        )
+
+    # --- weight updates ---
+    def _fanout(self, build: Callable[[], WeightUpdateRequests], timeout: float):
+        async def _one(addr: str, r: HttpRequest):
+            return await arequest_with_retry(
+                addr=addr,
+                endpoint=r.endpoint,
+                payload=r.payload,
+                method=r.method,
+                max_retries=self.config.request_retries,
+                timeout=timeout,
+            )
+
+        async def _all():
+            reqs = build().requests
+            await asyncio.gather(
+                *[_one(a, r) for a in self.addresses for r in reqs]
+            )
+
+        # run on a private loop in this (caller) thread: pause/update/resume
+        # is a blocking control-plane action for the trainer
+        asyncio.run(_all())
+
+    def pause_generation(self):
+        self._fanout(
+            lambda: WeightUpdateRequests(requests=[self.backend.build_pause_request()]),
+            timeout=60.0,
+        )
+        if self.config.pause_grace_period > 0:
+            time.sleep(self.config.pause_grace_period)
+
+    def continue_generation(self):
+        self._fanout(
+            lambda: WeightUpdateRequests(
+                requests=[self.backend.build_resume_request()]
+            ),
+            timeout=60.0,
+        )
+
+    def update_weights(self, meta: WeightUpdateMeta) -> None:
+        """Fan the weight-update request out to every server and bump the
+        client version afterwards (servers tag subsequent tokens with it)."""
+        self._fanout(
+            lambda: self.backend.build_weight_update_requests(meta),
+            timeout=self.config.request_timeout,
+        )
+
+    # --- rollout surface: delegate to the executor ---
+    def submit(self, data, workflow=None, workflow_builder=None, should_accept=None):
+        self.executor.submit(data, workflow, workflow_builder, should_accept)
+
+    def wait(self, count: int, timeout: Optional[float] = None):
+        return self.executor.wait(count, timeout)
+
+    def rollout_batch(
+        self, data, workflow=None, workflow_builder=None, should_accept=None
+    ):
+        return self.executor.rollout_batch(
+            data, workflow, workflow_builder, should_accept
+        )
+
+    def prepare_batch(
+        self, dataloader, workflow=None, workflow_builder=None, should_accept=None
+    ):
+        return self.executor.prepare_batch(
+            dataloader, workflow, workflow_builder, should_accept
+        )
+
+    def pause(self):
+        self.executor.pause()
+
+    def resume(self):
+        self.executor.resume()

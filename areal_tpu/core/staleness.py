@@ -1,0 +1,63 @@
+"""Staleness-aware rollout capacity control — THE async-RL throttle.
+
+Behavioral counterpart of the reference's `StalenessManager`
+(areal/core/staleness_manager.py:12); the capacity formula at
+staleness_manager.py:96 is preserved exactly:
+
+    capacity = min(max_concurrent - running,
+                   (max_staleness + version + 1) * batch_size
+                       - (accepted + running))
+
+so that by the time a sample is consumed, its off-policyness cannot exceed
+`max_staleness` versions.
+"""
+
+import threading
+from dataclasses import asdict
+
+from areal_tpu.api.io_struct import RolloutStat
+
+
+class StalenessManager:
+    def __init__(
+        self,
+        max_concurrent_rollouts: int,
+        consumer_batch_size: int,
+        max_staleness: int,
+    ):
+        self.max_concurrent_rollouts = max_concurrent_rollouts
+        self.consumer_batch_size = consumer_batch_size
+        self.max_staleness = max_staleness
+        self._lock = threading.Lock()
+        self._stat = RolloutStat()
+
+    def get_capacity(self, current_version: int) -> int:
+        """Slots available for new rollouts; may be negative when over
+        capacity (submission must then stall)."""
+        with self._lock:
+            concurrency_cap = max(1, self.max_concurrent_rollouts) - self._stat.running
+            sample_cnt = self._stat.accepted + self._stat.running
+            staleness_cap = (
+                (self.max_staleness + current_version + 1)
+                * max(1, self.consumer_batch_size)
+                - sample_cnt
+            )
+            return min(concurrency_cap, staleness_cap)
+
+    def on_rollout_submitted(self) -> None:
+        with self._lock:
+            self._stat.submitted += 1
+            self._stat.running += 1
+
+    def on_rollout_accepted(self) -> None:
+        with self._lock:
+            self._stat.accepted += 1
+            self._stat.running -= 1
+
+    def on_rollout_rejected(self) -> None:
+        with self._lock:
+            self._stat.running -= 1
+
+    def get_stats(self) -> RolloutStat:
+        with self._lock:
+            return RolloutStat(**asdict(self._stat))

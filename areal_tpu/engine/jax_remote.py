@@ -1,0 +1,86 @@
+"""Backend protocol for the areal_tpu JAX generation server.
+
+Counterpart of the reference's `SGLangBackend`/`RemoteSGLangEngine`
+(areal/engine/sglang_remote.py:22,173), speaking this framework's own server
+wire format (areal_tpu/gen/server.py):
+
+    POST /generate                     {rid, input_ids, sampling_params}
+    POST /pause_generation             {}
+    POST /continue_generation          {}
+    POST /update_weights_from_disk     {path, version}
+    POST /update_weights_chunk         {name, dtype, shape, data_b64, ...}
+    GET  /health, /metrics
+
+Responses carry `output_tokens`, `output_logprobs`, `stop_reason`
+("stop" | "length" | "abort") and the server's current weight `version` so
+the client can tag per-token versions without a race.
+"""
+
+from typing import Any, Dict
+
+from areal_tpu.api.config import InferenceEngineConfig
+from areal_tpu.api.io_struct import (
+    HttpGenerationResult,
+    HttpRequest,
+    ModelRequest,
+    WeightUpdateMeta,
+    WeightUpdateRequests,
+)
+from areal_tpu.core.remote import RemoteInfEngine
+
+
+class JaxBackend:
+    def build_generation_request(self, req: ModelRequest) -> HttpRequest:
+        g = req.gconfig
+        payload = {
+            "rid": req.rid,
+            "input_ids": list(req.input_ids),
+            "sampling_params": {
+                "max_new_tokens": g.max_new_tokens,
+                "min_new_tokens": g.min_new_tokens,
+                "temperature": 0.0 if g.greedy else g.temperature,
+                "top_p": g.top_p,
+                "top_k": g.top_k,
+                "stop_token_ids": list(g.stop_token_ids),
+                "frequency_penalty": g.frequency_penalty,
+            },
+        }
+        return HttpRequest(endpoint="/generate", payload=payload)
+
+    def parse_generation_response(self, resp: Dict[str, Any]) -> HttpGenerationResult:
+        return HttpGenerationResult(
+            output_tokens=list(resp["output_tokens"]),
+            output_logprobs=list(resp["output_logprobs"]),
+            stop_reason=resp["stop_reason"],
+            version=int(resp.get("version", -1)),
+        )
+
+    def build_pause_request(self) -> HttpRequest:
+        return HttpRequest(endpoint="/pause_generation", payload={})
+
+    def build_resume_request(self) -> HttpRequest:
+        return HttpRequest(endpoint="/continue_generation", payload={})
+
+    def build_weight_update_requests(
+        self, meta: WeightUpdateMeta
+    ) -> WeightUpdateRequests:
+        if meta.type == "disk":
+            return WeightUpdateRequests(
+                requests=[
+                    HttpRequest(
+                        endpoint="/update_weights_from_disk",
+                        payload={"path": meta.path},
+                    )
+                ]
+            )
+        raise NotImplementedError(
+            f"weight update type {meta.type!r} is pushed chunk-wise by the "
+            "trainer (see JaxTrainEngine.update_weights), not via this backend"
+        )
+
+
+class RemoteJaxEngine(RemoteInfEngine):
+    """Inference-engine client for areal_tpu generation servers."""
+
+    def __init__(self, config: InferenceEngineConfig):
+        super().__init__(config, backend=JaxBackend())

@@ -232,10 +232,19 @@ def pad_packed_tensor_dict(
     out = dict(packed)
     cur = int(packed["segment_ids"].shape[0])
     extra = target - cur
-    token_keys = set(str(k) for k in packed.get("__token_keys__", [])) | {
-        "segment_ids",
-        "positions",
-    }
+    if "__token_keys__" in packed:
+        token_keys = set(str(k) for k in packed["__token_keys__"])
+    else:  # external packed dict: every flat buffer of the current length
+        token_keys = {
+            k
+            for k, arr in packed.items()
+            if k not in _NON_SEQ_KEYS
+            and k != "__token_keys__"
+            and isinstance(arr, np.ndarray)
+            and arr.ndim >= 1
+            and arr.shape[0] == cur
+        }
+    token_keys |= {"segment_ids", "positions"}
     for k in token_keys:
         arr = packed[k]
         if extra < 0:  # shrink only ever removes filler (target >= total checked)
@@ -374,7 +383,11 @@ def to_jax(batch: Dict[str, np.ndarray], device=None):
     import jax
 
     return {
-        k: (jax.device_put(v, device) if isinstance(v, np.ndarray) else v)
+        k: (
+            jax.device_put(v, device)
+            if isinstance(v, np.ndarray) and v.dtype.kind not in "USO"
+            else v
+        )
         for k, v in batch.items()
     }
 

@@ -1,0 +1,71 @@
+"""Stateful batch dataloader over list-like datasets.
+
+Replaces the reference's torchdata `StatefulDataLoader` dependency with a
+minimal implementation carrying the same capabilities used by the framework:
+deterministic per-epoch shuffling, drop_last batching, and checkpointable
+iteration state (`state_dict`/`load_state_dict`) for recover-and-resume.
+"""
+
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+
+def _default_collate(items: List[Any]) -> List[Any]:
+    return items
+
+
+class StatefulDataLoader:
+    def __init__(
+        self,
+        dataset: Sequence,
+        batch_size: int,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+        collate_fn: Optional[Callable] = None,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.collate_fn = collate_fn or _default_collate
+        self._epoch = 0
+        self._batch_idx = 0  # next batch index within the epoch
+
+    def _order(self, epoch: int) -> List[int]:
+        idx = list(range(len(self.dataset)))
+        if self.shuffle:
+            random.Random((self.seed, epoch).__hash__()).shuffle(idx)
+        return idx
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Any]:
+        order = self._order(self._epoch)
+        n_batches = len(self)
+        while self._batch_idx < n_batches:
+            s = self._batch_idx * self.batch_size
+            batch_idx = order[s : s + self.batch_size]
+            self._batch_idx += 1
+            yield self.collate_fn([self.dataset[i] for i in batch_idx])
+        self._epoch += 1
+        self._batch_idx = 0
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"epoch": self._epoch, "batch_idx": self._batch_idx}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        self._epoch = state["epoch"]
+        self._batch_idx = state["batch_idx"]
+
+
+def cycle_dataloader(dataloader: StatefulDataLoader) -> Iterator[Any]:
+    while True:
+        yield from dataloader

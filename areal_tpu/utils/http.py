@@ -1,0 +1,107 @@
+"""Async HTTP with retry (reference: areal/utils/http.py arequest_with_retry)."""
+
+import asyncio
+from typing import Any, Dict, Optional
+
+import aiohttp
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("http")
+
+_CONNECTOR: Optional[aiohttp.TCPConnector] = None
+
+
+def get_default_connector() -> aiohttp.TCPConnector:
+    # A fresh connector per session: sessions are created per-request-context
+    # on the runner's event loop, and connectors cannot be shared across loops.
+    return aiohttp.TCPConnector(limit=0, ttl_dns_cache=300)
+
+
+class HttpRequestError(RuntimeError):
+    pass
+
+
+async def arequest_with_retry(
+    addr: str,
+    endpoint: str,
+    payload: Optional[Dict[str, Any]] = None,
+    method: str = "POST",
+    max_retries: int = 3,
+    timeout: float = 3600,
+    retry_delay: float = 0.5,
+    session: Optional[aiohttp.ClientSession] = None,
+) -> Dict[str, Any]:
+    url = f"http://{addr}{endpoint}"
+    last_exc: Optional[BaseException] = None
+    owns_session = session is None
+    if owns_session:
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=min(30, timeout)),
+            connector=get_default_connector(),
+        )
+    try:
+        for attempt in range(max_retries):
+            try:
+                async with session.request(
+                    method, url, json=payload if method != "GET" else None
+                ) as resp:
+                    if resp.status == 200:
+                        ctype = resp.headers.get("Content-Type", "")
+                        if "application/json" in ctype:
+                            return await resp.json()
+                        return {"text": await resp.text()}
+                    body = await resp.text()
+                    last_exc = HttpRequestError(
+                        f"{method} {url} -> HTTP {resp.status}: {body[:200]}"
+                    )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                last_exc = e
+            if attempt < max_retries - 1:
+                await asyncio.sleep(retry_delay * (2**attempt))
+        raise HttpRequestError(
+            f"request to {url} failed after {max_retries} attempts"
+        ) from last_exc
+    finally:
+        if owns_session:
+            await session.close()
+
+
+def request_with_retry_sync(
+    addr: str,
+    endpoint: str,
+    payload: Optional[Dict[str, Any]] = None,
+    method: str = "POST",
+    max_retries: int = 3,
+    timeout: float = 3600,
+) -> Dict[str, Any]:
+    """Blocking variant for non-async contexts (launchers, tools)."""
+    import requests
+
+    url = f"http://{addr}{endpoint}"
+    last_exc: Optional[BaseException] = None
+    for attempt in range(max_retries):
+        try:
+            resp = requests.request(
+                method,
+                url,
+                json=payload if method != "GET" else None,
+                timeout=timeout,
+            )
+            if resp.status_code == 200:
+                try:
+                    return resp.json()
+                except ValueError:
+                    return {"text": resp.text}
+            last_exc = HttpRequestError(
+                f"{method} {url} -> HTTP {resp.status_code}: {resp.text[:200]}"
+            )
+        except OSError as e:
+            last_exc = e
+        if attempt < max_retries - 1:
+            import time
+
+            time.sleep(0.5 * (2**attempt))
+    raise HttpRequestError(
+        f"request to {url} failed after {max_retries} attempts"
+    ) from last_exc

@@ -25,7 +25,6 @@ class ReduceType(Enum):
     SUM = "sum"
     MIN = "min"
     MAX = "max"
-    SCALAR = "scalar"
 
 
 def _asarray(x) -> np.ndarray:

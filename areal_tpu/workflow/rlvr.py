@@ -1,0 +1,115 @@
+"""RLVR (RL with verifiable rewards) rollout workflow.
+
+Behavioral counterpart of the reference's `RLVRWorkflow`
+(areal/workflow/rlvr.py:37): generate `n_samples` completions per prompt
+concurrently, score each with a (sync) reward function run off-loop, and emit
+one padded trajectory batch.  Per-token `versions` from the inference engine
+ride along for decoupled-PPO staleness correction.
+"""
+
+import asyncio
+import os
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward import AsyncRewardWrapper
+from areal_tpu.api.workflow import RolloutWorkflow
+from areal_tpu.utils import logging
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+logger = logging.getLogger("rlvr")
+
+
+class RLVRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        enable_thinking: bool = False,
+        rollout_stat_scope: str = "rollout",
+        dump_dir: Optional[str] = None,
+    ):
+        self.reward_fn = AsyncRewardWrapper(reward_fn)
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.enable_thinking = enable_thinking
+        self.dump_dir = dump_dir
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+
+    def _tokenize_prompt(self, data: Dict[str, Any]):
+        if "input_ids" in data:
+            return list(data["input_ids"])
+        if self.tokenizer is None:
+            raise ValueError("need tokenizer or pre-tokenized input_ids")
+        if "messages" in data:
+            return self.tokenizer.apply_chat_template(
+                data["messages"],
+                add_generation_prompt=True,
+                tokenize=True,
+                enable_thinking=self.enable_thinking,
+            )
+        return self.tokenizer.encode(data["prompt"])
+
+    async def arun_episode(self, engine, data: Dict[str, Any]):
+        input_ids = self._tokenize_prompt(data)
+        n = self.gconfig.n_samples
+        req = ModelRequest(
+            rid=str(uuid.uuid4()),
+            input_ids=input_ids,
+            gconfig=self.gconfig.new(n_samples=1),
+            tokenizer=self.tokenizer,
+        )
+        resps = await asyncio.gather(
+            *[engine.agenerate(req.copy()) for _ in range(n)]
+        )
+        results = []
+        for resp in resps:
+            completion_str = (
+                self.tokenizer.decode(resp.output_tokens)
+                if self.tokenizer is not None
+                else ""
+            )
+            prompt_str = (
+                self.tokenizer.decode(resp.input_tokens)
+                if self.tokenizer is not None
+                else ""
+            )
+            reward = await self.reward_fn(
+                prompt_str,
+                completion_str,
+                resp.input_tokens,
+                resp.output_tokens,
+                **data,
+            )
+            seq = resp.input_tokens + resp.output_tokens
+            logprobs = [0.0] * resp.input_len + resp.output_logprobs
+            loss_mask = [0] * resp.input_len + [1] * resp.output_len
+            versions = [-1] * resp.input_len + resp.output_versions
+            results.append(
+                dict(
+                    input_ids=np.array(seq, dtype=np.int32),
+                    logprobs=np.array(logprobs, dtype=np.float32),
+                    loss_mask=np.array(loss_mask, dtype=np.int32),
+                    versions=np.array(versions, dtype=np.int32),
+                    rewards=np.float32(reward),
+                )
+            )
+            if self.dump_dir:
+                self._dump(data, prompt_str, completion_str, reward, resp)
+        return pad_sequences_to_tensors(results)
+
+    def _dump(self, data, prompt_str, completion_str, reward, resp):
+        qid = str(data.get("query_id", data.get("qid", "unknown")))
+        path = os.path.join(self.dump_dir, f"{qid}.txt")
+        with open(path, "a") as f:
+            f.write(
+                f"prompt: {prompt_str}\ncompletion: {completion_str}\n"
+                f"reward: {reward} stop: {resp.stop_reason} "
+                f"len: {resp.output_len}\n{'-' * 40}\n"
+            )

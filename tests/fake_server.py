@@ -1,0 +1,145 @@
+"""Fake generation server speaking the areal_tpu wire protocol over real HTTP.
+
+The reference tests system logic against FastAPI fake SGLang servers
+(realhf/tests/system/test_gserver_manager.py:38); this is the same trick:
+an aiohttp server that "generates" deterministic tokens chunk-by-chunk, so
+client code (RemoteInfEngine, workflows, executor) is exercised against real
+sockets, including the abort/interruption path.
+"""
+
+import asyncio
+import threading
+from typing import List, Optional
+
+from aiohttp import web
+
+
+class FakeGenServer:
+    """Emits `chunk_size` tokens per /generate call, then stop_reason:
+
+    - "stop" once the scripted completion is exhausted,
+    - "length" when the request budget runs out,
+    - "abort" whenever `abort_next` is armed (simulating a weight-update
+      interruption mid-generation).
+    """
+
+    def __init__(
+        self,
+        completion: Optional[List[int]] = None,
+        chunk_size: int = 1024,
+        eos_token: Optional[int] = None,
+    ):
+        self.completion = completion if completion is not None else list(range(100, 108))
+        self.chunk_size = chunk_size
+        self.eos_token = eos_token
+        self.version = 0
+        self.paused = False
+        self.abort_once = False
+        self.requests: List[dict] = []
+        self.weight_updates: List[dict] = []
+        self.port: Optional[int] = None
+        self._runner = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    # --- handlers ---
+    async def _generate(self, request: web.Request):
+        body = await request.json()
+        self.requests.append(body)
+        prompt = body["input_ids"]
+        params = body["sampling_params"]
+        budget = params["max_new_tokens"]
+        # how much of the scripted completion has already been consumed is
+        # inferred from the prompt tail (interruption resends accumulated ids)
+        done = 0
+        for k in range(min(len(self.completion), len(prompt)), 0, -1):
+            if prompt[-k:] == self.completion[:k]:
+                done = k
+                break
+        remaining = self.completion[done:]
+        n = min(len(remaining), budget)
+        if self.abort_once:
+            n = min(n, max(1, len(remaining) // 2))  # interrupt mid-sequence
+        else:
+            n = min(n, self.chunk_size)
+        out = remaining[:n]
+        gen_version = self.version  # tokens carry the version that produced them
+        if self.abort_once:
+            stop = "abort"
+            self.abort_once = False
+            self.version += 1  # weight update happened during the interruption
+        elif n == len(remaining):
+            stop = "stop"
+        elif n >= budget:
+            stop = "length"
+        else:
+            stop = "abort"  # chunk cap reached: behave like chunked generation
+        return web.json_response(
+            {
+                "output_tokens": out,
+                "output_logprobs": [-0.5] * len(out),
+                "stop_reason": stop,
+                "version": gen_version,
+            }
+        )
+
+    async def _pause(self, request):
+        self.paused = True
+        return web.json_response({"ok": True})
+
+    async def _resume(self, request):
+        self.paused = False
+        return web.json_response({"ok": True})
+
+    async def _update_weights_from_disk(self, request):
+        body = await request.json()
+        self.weight_updates.append(body)
+        self.version += 1
+        return web.json_response({"ok": True, "version": self.version})
+
+    async def _health(self, request):
+        return web.json_response({"status": "ok", "version": self.version})
+
+    # --- lifecycle ---
+    def _make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/generate", self._generate)
+        app.router.add_post("/pause_generation", self._pause)
+        app.router.add_post("/continue_generation", self._resume)
+        app.router.add_post("/update_weights_from_disk", self._update_weights_from_disk)
+        app.router.add_get("/health", self._health)
+        return app
+
+    def start(self) -> str:
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                runner = web.AppRunner(self._make_app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                self._started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("fake server failed to start")
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._loop is not None:
+            async def _cleanup():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+
+            asyncio.run_coroutine_threadsafe(_cleanup(), self._loop).result(timeout=5)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)

@@ -108,3 +108,21 @@ def test_mesh_shape():
 def test_roundtrip_str():
     s = ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2)
     assert str(s) == "d4t2"
+
+
+def test_hybrid_ffn_expert_heavy():
+    # ep larger than the ffn section's dense product must parse (MoE folding)
+    m = AllocationMode.from_str("jax:d4+jax:(attn:d2c4|ffn:d2e4)")
+    assert m.train_hybrid.ffn.ep_size == 4
+
+
+def test_plain_expert_fold_divisibility():
+    m = AllocationMode.from_str("d4t2e2")
+    assert m.train.ep_size == 2
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("d3e2")  # 2 does not divide 3
+
+
+def test_gen_backend_rejected_as_train():
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("jax:d4+vllm:d2")

@@ -152,3 +152,28 @@ def test_pad_packed_shrink_preserves_metadata():
     assert len(unpack_sequence(shrunk)) == 2
     with pytest.raises(ValueError):
         pad_packed_tensor_dict(packed, 3)  # below real token count
+
+
+def test_to_jax_skips_string_arrays():
+    from areal_tpu.utils.data import to_jax
+
+    batch = pad_sequences_to_tensors([_traj(3)])
+    packed = pack_tensor_dict(batch)
+    j = to_jax(packed)
+    assert j["input_ids"].shape == (3,)  # on-device
+    assert j["__token_keys__"].dtype.kind == "U"  # left on host
+
+
+def test_pad_packed_external_dict_heuristic():
+    # external packed dict without __token_keys__: all flat buffers padded
+    ext = {
+        "input_ids": np.arange(5, dtype=np.int32),
+        "segment_ids": np.zeros(5, np.int32),
+        "positions": np.arange(5, np.int32) if False else np.arange(5, dtype=np.int32),
+        "cu_seqlens": np.array([0, 5], np.int32),
+        "max_seqlen": np.asarray(5, np.int32),
+        "total_lens": np.asarray(5, np.int32),
+    }
+    out = pad_packed_tensor_dict(ext, 12)
+    assert out["input_ids"].shape == (12,)
+    assert out["segment_ids"].shape == (12,)

@@ -1,0 +1,221 @@
+"""RemoteInfEngine + WorkflowExecutor against a fake HTTP generation server."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import GenerationHyperparameters, InferenceEngineConfig
+from areal_tpu.api.io_struct import ModelRequest, WeightUpdateMeta
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.utils import name_resolve, names
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+from tests.fake_server import FakeGenServer
+
+
+@pytest.fixture
+def server():
+    s = FakeGenServer(completion=list(range(100, 110)), chunk_size=1024)
+    addr = s.start()
+    yield s, addr
+    s.stop()
+
+
+def _engine(addr, **cfg_kwargs) -> RemoteJaxEngine:
+    cfg = InferenceEngineConfig(
+        experiment_name="e", trial_name="t", consumer_batch_size=2,
+        max_concurrent_rollouts=16, request_timeout=10, **cfg_kwargs,
+    )
+    eng = RemoteJaxEngine(cfg)
+    eng.initialize(addr=addr)
+    return eng
+
+
+def _agen(eng, req):
+    return asyncio.run(eng.agenerate(req))
+
+
+def test_basic_generation(server):
+    s, addr = server
+    eng = _engine(addr)
+    try:
+        resp = _agen(eng, ModelRequest(
+            input_ids=[1, 2, 3],
+            gconfig=GenerationHyperparameters(max_new_tokens=32),
+        ))
+        assert resp.output_tokens == list(range(100, 110))
+        assert resp.stop_reason == "stop"
+        assert resp.output_versions == [0] * 10
+        assert len(resp.output_logprobs) == 10
+        assert resp.input_tokens == [1, 2, 3]
+    finally:
+        eng.destroy()
+
+
+def test_length_cap(server):
+    s, addr = server
+    eng = _engine(addr)
+    try:
+        resp = _agen(eng, ModelRequest(
+            input_ids=[1],
+            gconfig=GenerationHyperparameters(max_new_tokens=4),
+        ))
+        assert resp.output_tokens == [100, 101, 102, 103]
+        assert resp.stop_reason == "length"
+    finally:
+        eng.destroy()
+
+
+def test_interruption_resumes_and_tracks_versions(server):
+    """Mid-generation abort: client must resend accumulated tokens and tag
+    later tokens with the new weight version (decoupled-PPO's raw signal)."""
+    s, addr = server
+    s.abort_once = True
+    eng = _engine(addr)
+    try:
+        resp = _agen(eng, ModelRequest(
+            input_ids=[7, 8],
+            gconfig=GenerationHyperparameters(max_new_tokens=64),
+        ))
+        assert resp.output_tokens == list(range(100, 110))
+        assert resp.stop_reason == "stop"
+        # versions must switch from 0 to 1 mid-sequence
+        assert resp.output_versions[0] == 0
+        assert resp.output_versions[-1] == 1
+        assert len(set(resp.output_versions)) == 2
+        # at least two HTTP calls: the aborted chunk + the resumption
+        assert len(s.requests) >= 2
+        # the resumption request must carry the accumulated prompt
+        assert s.requests[-1]["input_ids"][:2] == [7, 8]
+        assert 100 in s.requests[-1]["input_ids"]
+    finally:
+        eng.destroy()
+
+
+def test_chunked_generation(server):
+    s, addr = server
+    s.chunk_size = 3  # server yields 3 tokens per call ("abort" each chunk)
+    eng = _engine(addr)
+    try:
+        resp = _agen(eng, ModelRequest(
+            input_ids=[1],
+            gconfig=GenerationHyperparameters(max_new_tokens=100),
+        ))
+        assert resp.output_tokens == list(range(100, 110))
+        assert len(s.requests) == 4  # ceil(10/3)
+    finally:
+        eng.destroy()
+
+
+def test_update_weights_and_version(server):
+    s, addr = server
+    eng = _engine(addr)
+    try:
+        meta = WeightUpdateMeta(type="disk", path="/tmp/fake_ckpt")
+        eng.pause_generation()
+        assert s.paused
+        eng.update_weights(meta)
+        eng.set_version(eng.get_version() + 1)
+        eng.continue_generation()
+        assert not s.paused
+        assert s.weight_updates == [{"path": "/tmp/fake_ckpt"}]
+        assert eng.get_version() == 1
+        assert s.version == 1
+    finally:
+        eng.destroy()
+
+
+def test_discovery_via_name_resolve(server):
+    s, addr = server
+    name_resolve.add(names.gen_server("e", "t", "0"), addr)
+    cfg = InferenceEngineConfig(
+        experiment_name="e", trial_name="t", consumer_batch_size=1,
+        setup_timeout=5,
+    )
+    eng = RemoteJaxEngine(cfg)
+    eng.initialize()  # no addr: discover
+    try:
+        assert eng.addresses == [addr]
+    finally:
+        eng.destroy()
+
+
+def _reward_len(prompt, completion, prompt_ids, completion_ids, **kwargs):
+    return float(len(completion_ids))
+
+
+def test_rollout_batch_end_to_end(server):
+    s, addr = server
+    eng = _engine(addr)
+    try:
+        wf = RLVRWorkflow(
+            reward_fn=_reward_len,
+            gconfig=GenerationHyperparameters(max_new_tokens=16, n_samples=2),
+        )
+        batch = eng.rollout_batch(
+            [{"input_ids": [1, 2]}, {"input_ids": [3, 4, 5]}], workflow=wf
+        )
+        # 2 prompts x 2 samples
+        assert batch["input_ids"].shape[0] == 4
+        assert batch["rewards"].tolist() == [10.0] * 4
+        assert batch["attention_mask"].shape == batch["loss_mask"].shape
+        # loss mask zero on prompt, one on completion
+        lens = batch["attention_mask"].sum(-1)
+        for i in range(4):
+            n = int(lens[i])
+            assert batch["loss_mask"][i, :n].sum() == 10
+    finally:
+        eng.destroy()
+
+
+def test_prepare_batch_async(server):
+    from areal_tpu.utils.dataloader import StatefulDataLoader
+
+    s, addr = server
+    eng = _engine(addr, max_head_offpolicyness=2)
+    try:
+        wf = RLVRWorkflow(
+            reward_fn=_reward_len,
+            gconfig=GenerationHyperparameters(max_new_tokens=8),
+        )
+        dl = StatefulDataLoader(
+            [{"input_ids": [i]} for i in range(32)], batch_size=2
+        )
+        b1 = eng.prepare_batch(dl, workflow=wf)
+        b2 = eng.prepare_batch(dl, workflow=wf)
+        assert b1["input_ids"].shape[0] == 2
+        assert b2["input_ids"].shape[0] == 2
+        stats = eng.executor.staleness_manager.get_stats()
+        # staleness gate must bound total submissions:
+        # (eta + version + 1) * batch = (2+0+1)*2 = 6
+        assert stats.submitted <= 6
+    finally:
+        eng.destroy()
+
+
+def test_should_accept_filter(server):
+    s, addr = server
+    eng = _engine(addr)
+    try:
+        wf = RLVRWorkflow(
+            reward_fn=_reward_len,
+            gconfig=GenerationHyperparameters(max_new_tokens=4),
+        )
+        # reject everything once, then accept: executor must keep submitting
+        calls = {"n": 0}
+
+        def accept_second_half(traj):
+            calls["n"] += 1
+            return calls["n"] > 2
+
+        eng.submit({"input_ids": [1]}, workflow=wf,
+                   should_accept=accept_second_half)
+        eng.submit({"input_ids": [2]}, workflow=wf,
+                   should_accept=accept_second_half)
+        eng.submit({"input_ids": [3]}, workflow=wf,
+                   should_accept=accept_second_half)
+        batch = eng.wait(1, timeout=10)
+        assert batch["input_ids"].shape[0] == 1
+    finally:
+        eng.destroy()

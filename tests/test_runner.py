@@ -1,0 +1,102 @@
+import asyncio
+import time
+
+import pytest
+
+from areal_tpu.core.runner import AsyncTaskRunner, TaskError, TaskQueueFullError
+
+
+@pytest.fixture
+def runner():
+    r = AsyncTaskRunner(max_queue_size=64)
+    r.start()
+    yield r
+    r.stop()
+
+
+def test_basic_submit_wait(runner):
+    async def task():
+        await asyncio.sleep(0.01)
+        return 42
+
+    for _ in range(5):
+        runner.submit(task)
+    out = runner.wait(5, timeout=5)
+    assert out == [42] * 5
+
+
+def test_results_in_completion_order(runner):
+    async def slow():
+        await asyncio.sleep(0.3)
+        return "slow"
+
+    async def fast():
+        return "fast"
+
+    runner.submit(slow)
+    runner.submit(fast)
+    out = runner.wait(2, timeout=5)
+    assert out == ["fast", "slow"]
+
+
+def test_wait_timeout_preserves_results(runner):
+    async def task():
+        return 1
+
+    runner.submit(task)
+    with pytest.raises(TimeoutError):
+        runner.wait(3, timeout=0.3)
+    # the one completed result is still collectable
+    assert runner.wait(1, timeout=2) == [1]
+
+
+def test_exception_becomes_task_error(runner):
+    async def boom():
+        raise ValueError("nope")
+
+    runner.submit(boom)
+    (out,) = runner.wait(1, timeout=5)
+    assert isinstance(out, TaskError)
+    assert isinstance(out.exc, ValueError)
+
+
+def test_pause_blocks_new_tasks(runner):
+    runner.pause()
+
+    async def task():
+        return "ran"
+
+    runner.submit(task)
+    time.sleep(0.2)
+    with pytest.raises(TimeoutError):
+        runner.wait(1, timeout=0.2)
+    runner.resume()
+    assert runner.wait(1, timeout=2) == ["ran"]
+
+
+def test_queue_full():
+    r = AsyncTaskRunner(max_queue_size=2)
+    r.start()
+    r.pause()  # prevent dequeue
+
+    async def task():
+        return None
+
+    try:
+        r.submit(task)
+        r.submit(task)
+        with pytest.raises(TaskQueueFullError):
+            r.submit(task)
+    finally:
+        r.stop()
+
+
+def test_many_concurrent_tasks(runner):
+    async def task(i):
+        await asyncio.sleep(0.001 * (i % 7))
+        return i
+
+    for i in range(50):
+        runner.submit(lambda i=i: task(i))
+    out = runner.wait(50, timeout=10)
+    assert sorted(out) == list(range(50))

@@ -1,0 +1,93 @@
+"""StalenessManager tests — parity with reference test_staleness_manager.py
+(the capacity formula at staleness_manager.py:96 is the contract)."""
+
+import threading
+
+from areal_tpu.core.staleness import StalenessManager
+
+
+def test_concurrency_cap():
+    m = StalenessManager(max_concurrent_rollouts=4, consumer_batch_size=100,
+                         max_staleness=100)
+    assert m.get_capacity(0) == 4
+    for _ in range(4):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    m.on_rollout_accepted()
+    assert m.get_capacity(0) == 1
+
+
+def test_staleness_limit_zero():
+    # η=0: at version v, total samples allowed = (v+1)*B
+    B = 4
+    m = StalenessManager(max_concurrent_rollouts=1000, consumer_batch_size=B,
+                         max_staleness=0)
+    assert m.get_capacity(0) == B
+    for _ in range(B):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    # accepting does not free budget at the same version
+    for _ in range(B):
+        m.on_rollout_accepted()
+    assert m.get_capacity(0) == 0
+    # version bump frees exactly one more batch
+    assert m.get_capacity(1) == B
+
+
+def test_staleness_limit_eta():
+    B, eta = 2, 3
+    m = StalenessManager(max_concurrent_rollouts=1000, consumer_batch_size=B,
+                         max_staleness=eta)
+    assert m.get_capacity(0) == (eta + 1) * B
+    for _ in range((eta + 1) * B):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    assert m.get_capacity(2) == 2 * B
+
+
+def test_rejected_rollouts_free_capacity():
+    m = StalenessManager(max_concurrent_rollouts=10, consumer_batch_size=2,
+                         max_staleness=0)
+    m.on_rollout_submitted()
+    m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    m.on_rollout_rejected()
+    # rejected sample no longer counts against staleness budget
+    assert m.get_capacity(0) == 1
+
+
+def test_negative_capacity():
+    m = StalenessManager(max_concurrent_rollouts=2, consumer_batch_size=1,
+                         max_staleness=0)
+    for _ in range(2):
+        m.on_rollout_submitted()
+    # staleness budget of 1 sample, 2 running -> negative
+    assert m.get_capacity(0) < 0
+
+
+def test_min_clamps():
+    m = StalenessManager(max_concurrent_rollouts=0, consumer_batch_size=0,
+                         max_staleness=0)
+    # clamped to 1 concurrent & batch size 1
+    assert m.get_capacity(0) == 1
+
+
+def test_thread_safety():
+    m = StalenessManager(max_concurrent_rollouts=10**6,
+                         consumer_batch_size=10**6, max_staleness=10)
+    n, iters = 8, 500
+
+    def work():
+        for _ in range(iters):
+            m.on_rollout_submitted()
+            m.on_rollout_accepted()
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = m.get_stats()
+    assert s.submitted == n * iters
+    assert s.accepted == n * iters
+    assert s.running == 0
