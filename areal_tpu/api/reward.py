@@ -74,11 +74,15 @@ class AsyncRewardWrapper:
                     )
                 )
             except asyncio.TimeoutError:
+                # Do NOT retry a timeout: a running pool task cannot be
+                # cancelled, so resubmitting would occupy a second worker and
+                # a few hung reward fns would clog the whole pool
+                # (reference behavior: reward_api.py returns 0 on timeout).
                 fut.cancel()
                 logger.warning(
-                    f"reward fn timed out after {self.timeout}s "
-                    f"(attempt {attempt + 1}/{self.max_retries})"
+                    f"reward fn timed out after {self.timeout}s; returning 0"
                 )
+                return 0.0
             except BrokenExecutor:
                 logger.warning("reward process pool broke; recreating")
                 _recreate_pool()

@@ -97,7 +97,13 @@ class WorkflowExecutor:
     # --- episode wrapper ---
     def _make_task(self, ti: _TaskInput):
         async def _run():
-            traj = await ti.workflow.arun_episode(self.inference_engine, ti.data)
+            try:
+                traj = await ti.workflow.arun_episode(self.inference_engine, ti.data)
+            except BaseException:
+                # the submit-side increment must be balanced even on failure,
+                # or every crashed episode permanently eats one capacity slot
+                self.staleness_manager.on_rollout_rejected()
+                raise
             if traj is not None and self.config.check_trajectory_format:
                 check_trajectory_format(traj, self._expected_keys)
                 if self._expected_keys is None and "input_ids" in traj:
@@ -170,11 +176,16 @@ class WorkflowExecutor:
                 )
             except TimeoutError:
                 continue
+            # collect good results before surfacing any failure, so accepted
+            # trajectories from the same runner batch are not dropped
+            first_error: Optional[TaskError] = None
             for item in batch:
                 if isinstance(item, TaskError):
-                    raise RuntimeError("rollout task failed") from item.exc
-                if item is not None:
+                    first_error = first_error or item
+                elif item is not None:
                     self._pending_results.append(item)
+            if first_error is not None:
+                raise RuntimeError("rollout task failed") from first_error.exc
         results = self._pending_results[:count]
         self._pending_results = self._pending_results[count:]
         random.shuffle(results)

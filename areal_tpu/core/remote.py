@@ -261,8 +261,12 @@ class RemoteInfEngine(InferenceEngine):
         )
 
     def update_weights(self, meta: WeightUpdateMeta) -> None:
-        """Fan the weight-update request out to every server and bump the
-        client version afterwards (servers tag subsequent tokens with it)."""
+        """Fan the weight-update request out to every server.
+
+        The caller (train loop) advances the client's version explicitly with
+        `set_version(...)` after the update completes — same contract as the
+        reference's examples (gsm8k_grpo.py) — so staleness accounting stays
+        in the trainer's hands."""
         self._fanout(
             lambda: self.backend.build_weight_update_requests(meta),
             timeout=self.config.request_timeout,

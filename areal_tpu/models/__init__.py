@@ -1,0 +1,13 @@
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.models.transformer import (
+    forward,
+    init_params,
+    param_partition_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "param_partition_specs",
+]

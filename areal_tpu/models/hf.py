@@ -1,0 +1,215 @@
+"""HF checkpoint <-> param-pytree conversion.
+
+Capability counterpart of the reference's HF interop: lite loads via
+transformers AutoModelForCausalLM (areal/engine/base_hf_engine.py:46) and
+saves full state dicts (areal/engine/fsdp_engine.py:228-254); legacy keeps
+per-arch name maps (realhf/api/from_hf/{llama,qwen2,qwen3,mistral}.py).
+
+TPU-first: weights stream shard-by-shard from safetensors into numpy buffers
+stacked over the layer axis (our scan layout), never materialising a torch
+model.  Saving emits HF-format safetensors + config.json so any HF-ecosystem
+inference server (and our generation engine) can reload them — this is the
+"disk" weight-update path (reference: fsdp_engine.py:403-425).
+"""
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("models.hf")
+
+_LAYER_RE = re.compile(r"model\.layers\.(\d+)\.(.+)")
+
+# our (path-in-layer, transpose?) for each HF per-layer suffix
+_LAYER_MAP = {
+    "self_attn.q_proj.weight": (("attn", "wq"), True),
+    "self_attn.k_proj.weight": (("attn", "wk"), True),
+    "self_attn.v_proj.weight": (("attn", "wv"), True),
+    "self_attn.o_proj.weight": (("attn", "wo"), True),
+    "self_attn.q_proj.bias": (("attn", "bq"), False),
+    "self_attn.k_proj.bias": (("attn", "bk"), False),
+    "self_attn.v_proj.bias": (("attn", "bv"), False),
+    "self_attn.q_norm.weight": (("attn", "q_norm"), False),
+    "self_attn.k_norm.weight": (("attn", "k_norm"), False),
+    "mlp.gate_proj.weight": (("mlp", "w_gate"), True),
+    "mlp.up_proj.weight": (("mlp", "w_up"), True),
+    "mlp.down_proj.weight": (("mlp", "w_down"), True),
+    "input_layernorm.weight": (("input_norm",), False),
+    "post_attention_layernorm.weight": (("post_attn_norm",), False),
+}
+
+
+def _set_nested(tree: Dict, path: Tuple[str, ...], value):
+    for p in path[:-1]:
+        tree = tree.setdefault(p, {})
+    tree[path[-1]] = value
+
+
+def _get_nested(tree: Dict, path: Tuple[str, ...]):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def iter_safetensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (name, numpy array) over all safetensors shards in a dir."""
+    from safetensors import safe_open
+
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.endswith(".safetensors")
+        )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {path}")
+    for f in files:
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                yield name, sf.get_tensor(name)
+
+
+def load_hf_params(
+    path: str,
+    cfg: Optional[TransformerConfig] = None,
+    dtype: str = "float32",
+) -> Tuple[Dict[str, Any], TransformerConfig]:
+    """Load an HF checkpoint dir into the scan-stacked param pytree."""
+    if cfg is None:
+        cfg = TransformerConfig.from_hf(path)
+    L = cfg.num_layers
+    np_dtype = np.dtype(dtype)
+    params: Dict[str, Any] = {"layers": {}}
+
+    def layer_buf(path_in_layer: Tuple[str, ...], shape):
+        try:
+            return _get_nested(params["layers"], path_in_layer)
+        except KeyError:
+            buf = np.zeros((L, *shape), dtype=np_dtype)
+            _set_nested(params["layers"], path_in_layer, buf)
+            return buf
+
+    seen_head = False
+    for name, arr in iter_safetensors(path):
+        arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; astype below handles it
+        m = _LAYER_RE.match(name)
+        if m:
+            idx, suffix = int(m.group(1)), m.group(2)
+            if suffix not in _LAYER_MAP:
+                logger.warning("skipping unmapped weight %s", name)
+                continue
+            path_in_layer, transpose = _LAYER_MAP[suffix]
+            if transpose:
+                arr = arr.T
+            buf = layer_buf(path_in_layer, arr.shape)
+            buf[idx] = arr.astype(np_dtype)
+        elif name == "model.embed_tokens.weight":
+            params["embedding"] = arr.astype(np_dtype)
+        elif name == "model.norm.weight":
+            params["final_norm"] = arr.astype(np_dtype)
+        elif name == "lm_head.weight":
+            params["lm_head"] = arr.T.astype(np_dtype)
+            seen_head = True
+        else:
+            logger.warning("skipping unmapped weight %s", name)
+    if cfg.tie_word_embeddings and seen_head:
+        del params["lm_head"]
+    if not cfg.tie_word_embeddings and not seen_head:
+        raise ValueError("untied config but checkpoint has no lm_head.weight")
+    return params, cfg
+
+
+def params_to_hf_state(
+    params: Dict[str, Any], cfg: TransformerConfig
+) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield HF-named (name, array) pairs from the stacked pytree."""
+    yield "model.embed_tokens.weight", np.asarray(params["embedding"])
+    layers = params["layers"]
+    for i in range(cfg.num_layers):
+        prefix = f"model.layers.{i}."
+        for suffix, (path_in_layer, transpose) in _LAYER_MAP.items():
+            try:
+                buf = _get_nested(layers, path_in_layer)
+            except KeyError:
+                continue
+            arr = np.asarray(buf[i])
+            if transpose:
+                arr = arr.T
+            yield prefix + suffix, arr
+    yield "model.norm.weight", np.asarray(params["final_norm"])
+    if "lm_head" in params:
+        yield "lm_head.weight", np.asarray(params["lm_head"]).T
+    elif not cfg.tie_word_embeddings:
+        raise ValueError("untied config but params have no lm_head")
+
+
+def save_hf_checkpoint(
+    params: Dict[str, Any],
+    cfg: TransformerConfig,
+    out_dir: str,
+    save_dtype: str = "bfloat16",
+    max_shard_bytes: int = 4 * 1024**3,
+    tokenizer_src: Optional[str] = None,
+) -> None:
+    """Write an HF-format checkpoint dir (config.json + sharded safetensors
+    + weight index), castable to bf16 for serving."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg.to_hf_dict(), f, indent=2)
+
+    target = np.dtype(ml_dtypes.bfloat16) if save_dtype == "bfloat16" else np.dtype(
+        save_dtype
+    )
+    shards: List[Dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    weight_map: Dict[str, str] = {}
+    for name, arr in params_to_hf_state(params, cfg):
+        # np.asarray over a jax array may be stride-permuted (XLA layout) and
+        # transposes are views; safetensors serializes the raw buffer, so the
+        # array must be C-contiguous.
+        arr = np.ascontiguousarray(arr.astype(target))
+        if sizes[-1] + arr.nbytes > max_shard_bytes and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][name] = arr
+        sizes[-1] += arr.nbytes
+    n = len(shards)
+    for i, shard in enumerate(shards):
+        fname = (
+            "model.safetensors"
+            if n == 1
+            else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        )
+        save_file(shard, os.path.join(out_dir, fname))
+        for name in shard:
+            weight_map[name] = fname
+    if n > 1:
+        with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+            json.dump(
+                {"metadata": {"total_size": sum(sizes)}, "weight_map": weight_map},
+                f,
+            )
+    if tokenizer_src and os.path.isdir(tokenizer_src):
+        for fname in (
+            "tokenizer.json",
+            "tokenizer_config.json",
+            "vocab.json",
+            "merges.txt",
+            "special_tokens_map.json",
+            "generation_config.json",
+        ):
+            src = os.path.join(tokenizer_src, fname)
+            if os.path.exists(src):
+                shutil.copy(src, os.path.join(out_dir, fname))

@@ -1,0 +1,212 @@
+"""Model architecture config.
+
+One dataclass covers the decoder-only families the reference supports via its
+per-arch HF converters (reference: realhf/api/from_hf/{llama,qwen2,qwen3,
+mistral,gemma,gpt2,mixtral}.py and lite's AutoModelForCausalLM path,
+areal/engine/base_hf_engine.py:46): llama/mistral (no qkv bias, untied),
+qwen2 (qkv bias), qwen3 (qk-norm, explicit head_dim), gemma-style tied
+embeddings.  MoE fields cover the mixtral/qwen3-moe family.
+
+TPU-first: the config is a frozen, hashable pytree-static object so it can be
+closed over by `jax.jit` without retracing.
+"""
+
+import json
+import os
+from dataclasses import dataclass, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    max_position_embeddings: int = 32768
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False  # qwen2
+    qk_norm: bool = False  # qwen3
+    attn_logit_softcap: Optional[float] = None  # gemma2
+    sliding_window: Optional[int] = None
+
+    # MoE (mixtral / qwen3-moe); num_experts == 0 means dense
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+
+    # numerics
+    dtype: str = "bfloat16"  # compute/activation dtype
+    param_dtype: str = "float32"  # master weights
+    remat: bool = True  # jax.checkpoint each layer
+
+    # bookkeeping
+    hf_architecture: str = "LlamaForCausalLM"
+    bos_token_id: Optional[int] = 1
+    eos_token_id: Optional[int] = 2
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim_
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim_
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return replace(self, **kw)
+
+    # ------------------------------------------------------------------
+    # HF interop
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_hf(cls, path_or_dict) -> "TransformerConfig":
+        """Build from an HF `config.json` (path to a checkpoint dir, a json
+        file, or an already-parsed dict)."""
+        if isinstance(path_or_dict, dict):
+            d = path_or_dict
+        else:
+            p = path_or_dict
+            if os.path.isdir(p):
+                p = os.path.join(p, "config.json")
+            with open(p) as f:
+                d = json.load(f)
+        archs = d.get("architectures") or ["LlamaForCausalLM"]
+        arch = archs[0]
+        model_type = d.get("model_type", "llama")
+        qkv_bias = bool(d.get("attention_bias", False))
+        qk_norm = False
+        if model_type == "qwen2":
+            # qwen2 HF configs carry no attention_bias flag; bias is implied
+            qkv_bias = d.get("attention_bias", True)
+        if model_type in ("qwen3", "qwen3_moe"):
+            qkv_bias = bool(d.get("attention_bias", False))
+            qk_norm = True
+        num_heads = d["num_attention_heads"]
+        eos = d.get("eos_token_id", 2)
+        if isinstance(eos, list):
+            eos = eos[0]
+        return cls(
+            vocab_size=d["vocab_size"],
+            hidden_size=d["hidden_size"],
+            intermediate_size=d.get("intermediate_size", 4 * d["hidden_size"]),
+            num_layers=d["num_hidden_layers"],
+            num_heads=num_heads,
+            num_kv_heads=d.get("num_key_value_heads", num_heads),
+            head_dim=d.get("head_dim"),
+            max_position_embeddings=d.get("max_position_embeddings", 32768),
+            rope_theta=float(d.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(d.get("rms_norm_eps", 1e-6)),
+            tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+            qkv_bias=qkv_bias,
+            qk_norm=qk_norm,
+            sliding_window=(
+                d.get("sliding_window")
+                if d.get("use_sliding_window", model_type == "mistral")
+                else None
+            ),
+            num_experts=d.get("num_local_experts", d.get("num_experts", 0)) or 0,
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            moe_intermediate_size=d.get("moe_intermediate_size"),
+            hf_architecture=arch,
+            bos_token_id=d.get("bos_token_id", 1),
+            eos_token_id=eos,
+        )
+
+    def to_hf_dict(self) -> dict:
+        """Emit an HF-compatible config dict (for saving checkpoints that
+        inference servers / transformers can load back)."""
+        arch = self.hf_architecture
+        model_type = {
+            "LlamaForCausalLM": "llama",
+            "Qwen2ForCausalLM": "qwen2",
+            "Qwen3ForCausalLM": "qwen3",
+            "MistralForCausalLM": "mistral",
+        }.get(arch, "llama")
+        d = {
+            "architectures": [arch],
+            "model_type": model_type,
+            "vocab_size": self.vocab_size,
+            "hidden_size": self.hidden_size,
+            "intermediate_size": self.intermediate_size,
+            "num_hidden_layers": self.num_layers,
+            "num_attention_heads": self.num_heads,
+            "num_key_value_heads": self.num_kv_heads,
+            "max_position_embeddings": self.max_position_embeddings,
+            "rope_theta": self.rope_theta,
+            "rms_norm_eps": self.rms_norm_eps,
+            "tie_word_embeddings": self.tie_word_embeddings,
+            "hidden_act": "silu",
+            "torch_dtype": "bfloat16",
+            "bos_token_id": self.bos_token_id,
+            "eos_token_id": self.eos_token_id,
+        }
+        if self.head_dim is not None:
+            d["head_dim"] = self.head_dim
+        if model_type in ("qwen2", "qwen3", "mistral", "llama"):
+            d["attention_bias"] = self.qkv_bias
+        if self.sliding_window is not None:
+            d["sliding_window"] = self.sliding_window
+            d["use_sliding_window"] = True
+        return d
+
+
+# Handy presets for tests / benchmarks ------------------------------------
+
+def tiny_config(**kw) -> TransformerConfig:
+    base = dict(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        max_position_embeddings=512,
+        remat=False,
+        dtype="float32",
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def qwen25_1p5b() -> TransformerConfig:
+    """Qwen2.5-1.5B shapes — the reference's small benchmark model class
+    (BASELINE.md: 1.5B R1-Distill)."""
+    return TransformerConfig(
+        vocab_size=151936,
+        hidden_size=1536,
+        intermediate_size=8960,
+        num_layers=28,
+        num_heads=12,
+        num_kv_heads=2,
+        max_position_embeddings=32768,
+        rope_theta=1000000.0,
+        tie_word_embeddings=True,
+        qkv_bias=True,
+        hf_architecture="Qwen2ForCausalLM",
+    )
+
+
+def qwen25_7b() -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        max_position_embeddings=32768,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+        hf_architecture="Qwen2ForCausalLM",
+    )

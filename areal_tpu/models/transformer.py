@@ -1,0 +1,304 @@
+"""Functional decoder-only transformer, TPU-first.
+
+Capability counterpart of the reference's model runtimes (lite: HF
+AutoModelForCausalLM under FSDP2, areal/engine/base_hf_engine.py:46; legacy:
+ReaLModel, realhf/impl/model/nn/real_llm_api.py:100 with flash-attn varlen
+attention, realhf/impl/model/modules/attn.py:307).  Design differences:
+
+- Pure functions over a parameter pytree; no module system.  `jax.jit`
+  closes over the static `TransformerConfig`.
+- **Layer stacking + `lax.scan`**: all layers' weights live in single leaves
+  with a leading `num_layers` axis.  One layer is traced/compiled once
+  regardless of depth, and `jax.checkpoint` gives per-layer rematerialisation
+  (the HBM/FLOPs trade the reference gets from torch activation ckpt).
+- **Packed sequences via segment ids**: variable-length batches arrive as a
+  flat token buffer `[B, T]` (usually B=1) with `segment_ids`; attention
+  masks `seg_i == seg_j & causal`, replacing flash-attn varlen cu_seqlens.
+  Padding tokens carry segment_id -1 and attend to nothing.
+- Compute in bf16 on the MXU, master params fp32; softmax and norms in fp32.
+- Sharding is expressed once in `param_partition_specs` and applied by the
+  engine via NamedSharding; GSPMD inserts the collectives.
+"""
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.models.model_config import TransformerConfig
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
+    """positions [B, T] -> cos/sin [B, T, head_dim//2] in fp32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,T,hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, T, H, hd]; HF 'half rotation' convention (rotate_half)."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[:, :, None, :]  # [B,T,1,hd/2]
+    sin = sin[:, :, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def make_attention_mask(
+    segment_ids: jax.Array,
+    positions: jax.Array,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """[B, T] segment ids (-1 = pad) -> bool [B, 1, T, T] mask.
+
+    Causality is by *position within the segment*, so packed layouts where
+    each sequence restarts positions at 0 are handled uniformly with padded
+    layouts (positions strictly increase inside a segment).
+    """
+    seg_q = segment_ids[:, :, None]
+    seg_k = segment_ids[:, None, :]
+    same = (seg_q == seg_k) & (seg_q >= 0)
+    pos_q = positions[:, :, None]
+    pos_k = positions[:, None, :]
+    causal = pos_k <= pos_q
+    mask = same & causal
+    if sliding_window is not None:
+        mask &= pos_k > pos_q - sliding_window
+    return mask[:, None, :, :]
+
+
+def attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, S, Hkv, hd]
+    v: jax.Array,  # [B, S, Hkv, hd]
+    mask: jax.Array,  # bool [B, 1, T, S]
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Grouped-query attention with fp32 softmax. Returns [B, T, Hq, hd]."""
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    q = q.reshape(B, T, Hkv, group, hd)
+    scores = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
+    scores *= 1.0 / np.sqrt(hd)
+    if logit_softcap:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    mask = mask[:, :, None, :, :] if mask.ndim == 4 else mask  # [B,1,1,T,S]
+    scores = jnp.where(mask, scores, -2.3819763e38)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(B, T, Hq, hd)
+
+
+# ---------------------------------------------------------------------------
+# Layer / model forward
+# ---------------------------------------------------------------------------
+
+
+def _layer_forward(
+    cfg: TransformerConfig,
+    lp: Params,  # this layer's params (no leading L axis)
+    x: jax.Array,  # [B, T, D]
+    cos: jax.Array,
+    sin: jax.Array,
+    mask: jax.Array,
+    kv_cache: Optional[Dict[str, jax.Array]] = None,
+    cache_index: Optional[jax.Array] = None,
+):
+    """One decoder block. If kv_cache is given (decode), keys/values are
+    written at `cache_index` and attention runs over the cache."""
+    B, T, D = x.shape
+    hd = cfg.head_dim_
+    dtype = x.dtype
+
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = jnp.einsum("btd,dh->bth", h, lp["attn"]["wq"].astype(dtype))
+    k = jnp.einsum("btd,dh->bth", h, lp["attn"]["wk"].astype(dtype))
+    v = jnp.einsum("btd,dh->bth", h, lp["attn"]["wv"].astype(dtype))
+    if cfg.qkv_bias:
+        q = q + lp["attn"]["bq"].astype(dtype)
+        k = k + lp["attn"]["bk"].astype(dtype)
+        v = v + lp["attn"]["bv"].astype(dtype)
+    q = q.reshape(B, T, cfg.num_heads, hd)
+    k = k.reshape(B, T, cfg.num_kv_heads, hd)
+    v = v.reshape(B, T, cfg.num_kv_heads, hd)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["attn"]["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["attn"]["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_cache = None
+    if kv_cache is not None:
+        ck = jax.lax.dynamic_update_slice(
+            kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, cache_index, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0)
+        )
+        new_cache = {"k": ck, "v": cv}
+        k, v = ck.astype(dtype), cv.astype(dtype)
+
+    attn_out = attention(q, k, v, mask, cfg.attn_logit_softcap)
+    attn_out = attn_out.reshape(B, T, cfg.q_size)
+    attn_out = jnp.einsum("bth,hd->btd", attn_out, lp["attn"]["wo"].astype(dtype))
+    x = x + attn_out
+
+    h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    gate = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_gate"].astype(dtype))
+    up = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_up"].astype(dtype))
+    down = jnp.einsum(
+        "btf,fd->btd", jax.nn.silu(gate) * up, lp["mlp"]["w_down"].astype(dtype)
+    )
+    x = x + down
+    return x, new_cache
+
+
+def forward(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,  # int32 [B, T]
+    positions: jax.Array,  # int32 [B, T]
+    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+) -> jax.Array:
+    """Full forward -> logits [B, T, V] (in cfg.dtype; softmax-sensitive
+    consumers should upcast)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+    mask = make_attention_mask(segment_ids, positions, cfg.sliding_window)
+
+    layer_fn = functools.partial(_layer_forward, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def scan_body(x, lp):
+        x, _ = layer_fn(lp, x, cos, sin, mask)
+        return x, None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype))
+    return logits
+
+
+def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax.Array]):
+    """Convenience wrapper over a packed dict (flat [T] buffers)."""
+    ids = packed["input_ids"][None, :]
+    pos = packed["positions"][None, :]
+    seg = packed["segment_ids"][None, :]
+    return forward(params, cfg, ids, pos, seg)[0]
+
+
+# ---------------------------------------------------------------------------
+# Init & partitioning
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
+    """Random init (fan-in scaled normal), master dtype cfg.param_dtype."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    D, F, V, L = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size, cfg.num_layers
+    Hq, Hkv = cfg.q_size, cfg.kv_size
+    keys = jax.random.split(rng, 8)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(pdt)
+
+    layers = {
+        "attn": {
+            "wq": dense(keys[0], (L, D, Hq), D),
+            "wk": dense(keys[1], (L, D, Hkv), D),
+            "wv": dense(keys[2], (L, D, Hkv), D),
+            "wo": dense(keys[3], (L, Hq, D), Hq),
+        },
+        "mlp": {
+            "w_gate": dense(keys[4], (L, D, F), D),
+            "w_up": dense(keys[5], (L, D, F), D),
+            "w_down": dense(keys[6], (L, F, D), F),
+        },
+        "input_norm": jnp.ones((L, D), pdt),
+        "post_attn_norm": jnp.ones((L, D), pdt),
+    }
+    if cfg.qkv_bias:
+        layers["attn"]["bq"] = jnp.zeros((L, Hq), pdt)
+        layers["attn"]["bk"] = jnp.zeros((L, Hkv), pdt)
+        layers["attn"]["bv"] = jnp.zeros((L, Hkv), pdt)
+    if cfg.qk_norm:
+        layers["attn"]["q_norm"] = jnp.ones((L, cfg.head_dim_), pdt)
+        layers["attn"]["k_norm"] = jnp.ones((L, cfg.head_dim_), pdt)
+    params: Params = {
+        "embedding": dense(keys[7], (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), pdt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(jax.random.fold_in(keys[7], 1), (D, V), D)
+    return params
+
+
+def param_partition_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpecs over mesh axes ("fsdp", "tp").
+
+    Layout follows the megatron/GSPMD convention the reference realises with
+    DTensor TP plans (areal/utils/fsdp/parallel.py:10-18) and in-repo
+    Column/RowParallelLinear (realhf .../tensor_parallel/modules.py:737,885):
+    qkv & mlp-in column-split over tp, attn-out & mlp-down row-split; the
+    other axis is ZeRO-sharded over fsdp.  Vocab-parallel embedding/head.
+    """
+    attn = {
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+    }
+    if cfg.qkv_bias:
+        attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+    if cfg.qk_norm:
+        attn.update(q_norm=P(None, None), k_norm=P(None, None))
+    specs: Params = {
+        "embedding": P("tp", "fsdp"),
+        "layers": {
+            "attn": attn,
+            "mlp": {
+                "w_gate": P(None, "fsdp", "tp"),
+                "w_up": P(None, "fsdp", "tp"),
+                "w_down": P(None, "tp", "fsdp"),
+            },
+            "input_norm": P(None, "fsdp"),
+            "post_attn_norm": P(None, "fsdp"),
+        },
+        "final_norm": P("fsdp"),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P("fsdp", "tp")
+    return specs
+
+
+def param_count(params: Params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

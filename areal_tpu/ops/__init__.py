@@ -1,0 +1,30 @@
+from areal_tpu.ops.functional import (
+    dpo_loss_fn,
+    gather_logprobs,
+    gather_logprobs_entropy,
+    grpo_loss_fn,
+    kl_estimate,
+    masked_mean,
+    masked_normalize,
+    pairwise_reward_loss_fn,
+    ppo_actor_loss_fn,
+    ppo_critic_loss_fn,
+    sft_loss_fn,
+)
+from areal_tpu.ops.gae import gae_padded, gae_segments
+
+__all__ = [
+    "gather_logprobs",
+    "gather_logprobs_entropy",
+    "grpo_loss_fn",
+    "ppo_actor_loss_fn",
+    "ppo_critic_loss_fn",
+    "sft_loss_fn",
+    "pairwise_reward_loss_fn",
+    "dpo_loss_fn",
+    "kl_estimate",
+    "masked_mean",
+    "masked_normalize",
+    "gae_padded",
+    "gae_segments",
+]

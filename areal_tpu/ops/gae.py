@@ -1,0 +1,113 @@
+"""Generalised Advantage Estimation over padded and packed sequences.
+
+TPU-native counterpart of the reference's CUDA `cugae` kernel
+(csrc/cugae/gae.cu:10-60 `gae_1d_nolp_misalign`) and lite's python GAE loop
+(areal/engine/ppo/actor.py:136-151).  Instead of a hand-written backward CUDA
+kernel, a single reverse `jax.lax.scan` runs the recurrence
+
+    adv[t] = delta[t] + gamma * lam * (not boundary[t]) * adv[t+1]
+    delta[t] = r[t] + gamma * V[t+1] * (not boundary[t]) - V[t]
+
+across the whole (packed) buffer at once; sequence boundaries reset the
+carry, which is exactly the cu_seqlens-misalignment handling of the CUDA
+kernel, but shape-static and fusable by XLA.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def gae_padded(
+    rewards: jax.Array,  # [B, L]
+    values: jax.Array,  # [B, L]
+    mask: jax.Array,  # [B, L] 1 where token is valid
+    gamma: float,
+    lam: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """GAE over right-padded batches; bootstrap value after the last valid
+    token is 0 (terminal).  Returns (advantages, returns) masked to 0 on pads.
+    """
+    mask = mask.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32) * mask
+    values = values.astype(jnp.float32) * mask
+    # next value: V[t+1] if t+1 valid else 0
+    nxt = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1)
+    nxt_valid = jnp.concatenate([mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
+    delta = rewards + gamma * nxt * nxt_valid - values
+
+    def step(carry, xs):
+        d, valid_next = xs
+        adv = d + gamma * lam * valid_next * carry
+        return adv, adv
+
+    # reverse scan over time, batched over B via vmap-free transpose
+    _, adv_rev = jax.lax.scan(
+        step,
+        jnp.zeros(rewards.shape[0], jnp.float32),
+        (delta.T[::-1], nxt_valid.T[::-1]),
+    )
+    adv = adv_rev[::-1].T * mask
+    returns = adv + values
+    return adv, returns * mask
+
+
+def gae_segments(
+    rewards: jax.Array,  # [T] packed
+    values: jax.Array,  # [T]
+    segment_ids: jax.Array,  # [T], -1 on filler
+    gamma: float,
+    lam: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """GAE over a packed flat buffer; boundaries where segment id changes.
+
+    Equivalent to cugae's `gae_1d_nolp_misalign` with per-sequence terminal
+    bootstrap 0 (RLVR episodes end at the final token).
+    """
+    valid = segment_ids >= 0
+    rewards = jnp.where(valid, rewards.astype(jnp.float32), 0.0)
+    values = jnp.where(valid, values.astype(jnp.float32), 0.0)
+    nxt_same = jnp.concatenate(
+        [(segment_ids[1:] == segment_ids[:-1]) & valid[1:], jnp.zeros((1,), bool)]
+    )
+    nxt = jnp.concatenate([values[1:], jnp.zeros((1,), jnp.float32)])
+    delta = rewards + gamma * nxt * nxt_same - values
+
+    def step(carry, xs):
+        d, same = xs
+        adv = d + gamma * lam * same * carry
+        return adv, adv
+
+    _, adv_rev = jax.lax.scan(
+        step, jnp.zeros((), jnp.float32), (delta[::-1], nxt_same[::-1])
+    )
+    adv = jnp.where(valid, adv_rev[::-1], 0.0)
+    returns = adv + values
+    return adv, jnp.where(valid, returns, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Host-side numpy reference (used by tests and by host-side advantage calc)
+# ---------------------------------------------------------------------------
+
+
+def gae_numpy(
+    rewards: np.ndarray, values: np.ndarray, lens: np.ndarray, gamma: float, lam: float
+):
+    """Straightforward per-sequence loop over a padded [B, L] batch."""
+    B, L = rewards.shape
+    adv = np.zeros_like(rewards, dtype=np.float64)
+    for b in range(B):
+        n = int(lens[b])
+        carry = 0.0
+        for t in reversed(range(n)):
+            nxt = values[b, t + 1] if t + 1 < n else 0.0
+            delta = rewards[b, t] + gamma * nxt - values[b, t]
+            carry = delta + gamma * lam * carry
+            adv[b, t] = carry
+    ret = adv + np.where(
+        np.arange(L)[None, :] < lens[:, None], values.astype(np.float64), 0.0
+    )
+    return adv, ret

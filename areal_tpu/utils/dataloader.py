@@ -68,4 +68,12 @@ class StatefulDataLoader:
 
 def cycle_dataloader(dataloader: StatefulDataLoader) -> Iterator[Any]:
     while True:
-        yield from dataloader
+        yielded = False
+        for batch in dataloader:
+            yielded = True
+            yield batch
+        if not yielded:
+            raise ValueError(
+                "dataloader produced zero batches (dataset smaller than "
+                "batch_size with drop_last?) — cycling would spin forever"
+            )

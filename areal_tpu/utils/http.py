@@ -9,9 +9,6 @@ from areal_tpu.utils import logging
 
 logger = logging.getLogger("http")
 
-_CONNECTOR: Optional[aiohttp.TCPConnector] = None
-
-
 def get_default_connector() -> aiohttp.TCPConnector:
     # A fresh connector per session: sessions are created per-request-context
     # on the runner's event loop, and connectors cannot be shared across loops.

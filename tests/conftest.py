@@ -14,6 +14,12 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+# Numerics tests compare against fp32 torch references; XLA:CPU's default
+# (lower) einsum precision would drown parity in ~1e-3 noise.
+jax.config.update("jax_default_matmul_precision", "highest")
+
 import pytest  # noqa: E402
 
 

@@ -169,7 +169,7 @@ def test_pad_packed_external_dict_heuristic():
     ext = {
         "input_ids": np.arange(5, dtype=np.int32),
         "segment_ids": np.zeros(5, np.int32),
-        "positions": np.arange(5, np.int32) if False else np.arange(5, dtype=np.int32),
+        "positions": np.arange(5, dtype=np.int32),
         "cu_seqlens": np.array([0, 5], np.int32),
         "max_seqlen": np.asarray(5, np.int32),
         "total_lens": np.asarray(5, np.int32),

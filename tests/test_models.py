@@ -1,0 +1,164 @@
+"""Model numerics: parity vs HF transformers (torch CPU) and packed-vs-padded
+consistency (ports the reference's test strategy:
+areal/tests/test_packed_vs_padded_consistency.py and
+realhf/tests/model/test_cpu_inference.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from areal_tpu.models import TransformerConfig, forward, init_params
+from areal_tpu.models.hf import load_hf_params, save_hf_checkpoint
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.utils.data import pack_tensor_dict
+
+
+def _hf_tiny(arch: str, tmp_path, tie=False):
+    import torch
+    import transformers
+
+    common = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        tie_word_embeddings=tie,
+        torch_dtype="float32",
+    )
+    if arch == "qwen2":
+        hf_cfg = transformers.Qwen2Config(**common)
+        model = transformers.Qwen2ForCausalLM(hf_cfg)
+    elif arch == "qwen3":
+        hf_cfg = transformers.Qwen3Config(**common, head_dim=16)
+        model = transformers.Qwen3ForCausalLM(hf_cfg)
+    elif arch == "llama":
+        hf_cfg = transformers.LlamaConfig(**common)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+    else:
+        raise ValueError(arch)
+    model = model.eval().to(torch.float32)
+    out_dir = tmp_path / arch
+    model.save_pretrained(out_dir, safe_serialization=True)
+    return model, str(out_dir)
+
+
+@pytest.mark.parametrize("arch", ["qwen2", "llama", "qwen3"])
+def test_hf_parity(arch, tmp_path):
+    import torch
+
+    model, ckpt = _hf_tiny(arch, tmp_path)
+    params, cfg = load_hf_params(ckpt)
+    cfg = cfg.replace(dtype="float32", remat=False)
+
+    rng = np.random.default_rng(0)
+    B, L = 2, 17
+    ids = rng.integers(0, cfg.vocab_size, (B, L)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids).long()).logits.numpy()
+
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
+    seg = np.broadcast_to(np.arange(B, dtype=np.int32)[:, None], (B, L))
+    got = np.asarray(forward(params, cfg, ids, pos, seg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_packed_vs_padded_consistency():
+    import jax
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    lens = [5, 9, 3]
+    B, L = len(lens), max(lens)
+    ids = np.zeros((B, L), np.int32)
+    mask = np.zeros((B, L), bool)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rng.integers(0, cfg.vocab_size, n)
+        mask[i, :n] = True
+
+    # padded forward
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L)).copy()
+    seg = np.where(mask, np.arange(B, dtype=np.int32)[:, None], -1).astype(np.int32)
+    padded_logits = np.asarray(forward(params, cfg, ids, pos, seg))
+
+    # packed forward with bucket padding
+    packed = pack_tensor_dict({"input_ids": ids, "attention_mask": mask}, pad_to=32)
+    logits = np.asarray(
+        forward(
+            params,
+            cfg,
+            packed["input_ids"][None],
+            packed["positions"][None],
+            packed["segment_ids"][None],
+        )
+    )[0]
+    cu = packed["cu_seqlens"]
+    for i, n in enumerate(lens):
+        np.testing.assert_allclose(
+            logits[cu[i] : cu[i] + n], padded_logits[i, :n], rtol=1e-5, atol=1e-5
+        )
+
+
+def test_sequences_independent_in_pack():
+    """A sequence's logits don't change based on what it is packed with."""
+    import jax
+
+    cfg = tiny_config()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+    b = rng.integers(0, cfg.vocab_size, 4).astype(np.int32)
+
+    def run_packed(seqs, pad_to):
+        ids = np.concatenate(seqs)
+        seg = np.concatenate([np.full(len(s), i, np.int32) for i, s in enumerate(seqs)])
+        pos = np.concatenate([np.arange(len(s), dtype=np.int32) for s in seqs])
+        extra = pad_to - len(ids)
+        ids = np.pad(ids, (0, extra))
+        seg = np.pad(seg, (0, extra), constant_values=-1)
+        pos = np.pad(pos, (0, extra))
+        return np.asarray(forward(params, cfg, ids[None], pos[None], seg[None]))[0]
+
+    both = run_packed([a, b], 16)
+    alone = run_packed([a], 16)
+    np.testing.assert_allclose(both[: len(a)], alone[: len(a)], rtol=1e-5, atol=1e-5)
+
+
+def test_save_roundtrip_and_transformers_reload(tmp_path):
+    import jax
+    import torch
+    import transformers
+
+    cfg = tiny_config(
+        vocab_size=256, qkv_bias=True, hf_architecture="Qwen2ForCausalLM"
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    out = tmp_path / "ckpt"
+    save_hf_checkpoint(params, cfg, str(out), save_dtype="float32")
+
+    with open(out / "config.json") as f:
+        d = json.load(f)
+    assert d["architectures"] == ["Qwen2ForCausalLM"]
+
+    # our loader roundtrip
+    params2, cfg2 = load_hf_params(str(out))
+    for p1, p2 in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(params2)
+    ):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6, atol=1e-6)
+
+    # transformers can load it and agrees on logits
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(out), torch_dtype=torch.float32
+    ).eval()
+    ids = np.arange(10, dtype=np.int32)[None] % cfg.vocab_size
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.arange(10, dtype=np.int32)[None]
+    seg = np.zeros((1, 10), np.int32)
+    got = np.asarray(forward(params, cfg, ids, pos, seg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)

@@ -1,0 +1,173 @@
+"""Numerics for GAE and loss functions vs independent references
+(ports the reference's kernel-vs-reference tests:
+realhf/tests/cpp_extensions/test_cugae.py, tests/data/test_dual_clip.py)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.ops import (
+    gae_padded,
+    gae_segments,
+    gather_logprobs,
+    gather_logprobs_entropy,
+    grpo_loss_fn,
+    kl_estimate,
+    masked_normalize,
+    pairwise_reward_loss_fn,
+    ppo_actor_loss_fn,
+    ppo_critic_loss_fn,
+    sft_loss_fn,
+)
+from areal_tpu.ops.gae import gae_numpy
+
+
+def _rand_batch(rng, B=3, L=11):
+    lens = rng.integers(2, L + 1, B)
+    mask = np.arange(L)[None, :] < lens[:, None]
+    rewards = rng.normal(size=(B, L)).astype(np.float32) * mask
+    values = rng.normal(size=(B, L)).astype(np.float32) * mask
+    return rewards, values, lens, mask
+
+
+def test_gae_padded_matches_numpy():
+    rng = np.random.default_rng(0)
+    rewards, values, lens, mask = _rand_batch(rng)
+    adv, ret = gae_padded(rewards, values, mask, gamma=0.99, lam=0.95)
+    ref_adv, ref_ret = gae_numpy(rewards, values, lens, 0.99, 0.95)
+    np.testing.assert_allclose(np.asarray(adv), ref_adv, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), ref_ret, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_segments_matches_padded():
+    rng = np.random.default_rng(1)
+    rewards, values, lens, mask = _rand_batch(rng, B=4, L=9)
+    adv_p, _ = gae_padded(rewards, values, mask, gamma=1.0, lam=0.9)
+    # pack
+    flat_r = np.concatenate([rewards[b, : lens[b]] for b in range(4)])
+    flat_v = np.concatenate([values[b, : lens[b]] for b in range(4)])
+    seg = np.concatenate([np.full(lens[b], b, np.int32) for b in range(4)])
+    # add filler
+    flat_r = np.pad(flat_r, (0, 5))
+    flat_v = np.pad(flat_v, (0, 5))
+    seg = np.pad(seg, (0, 5), constant_values=-1)
+    adv_s, _ = gae_segments(flat_r, flat_v, seg, gamma=1.0, lam=0.9)
+    adv_s = np.asarray(adv_s)
+    ofs = 0
+    for b in range(4):
+        n = int(lens[b])
+        np.testing.assert_allclose(
+            adv_s[ofs : ofs + n], np.asarray(adv_p)[b, :n], rtol=1e-5, atol=1e-5
+        )
+        ofs += n
+    assert np.all(adv_s[ofs:] == 0)
+
+
+def test_gather_logprobs_vs_torch():
+    import torch
+
+    rng = np.random.default_rng(2)
+    logits = rng.normal(size=(7, 13)).astype(np.float32)
+    labels = rng.integers(0, 13, 7)
+    ref = (
+        torch.log_softmax(torch.from_numpy(logits), dim=-1)
+        .gather(-1, torch.from_numpy(labels)[:, None])[:, 0]
+        .numpy()
+    )
+    got = np.asarray(gather_logprobs(logits, labels))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    got2, ent = gather_logprobs_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(got2), ref, rtol=1e-4, atol=1e-4)
+    p = torch.softmax(torch.from_numpy(logits), -1)
+    ref_ent = -(p * p.log()).sum(-1).numpy()
+    np.testing.assert_allclose(np.asarray(ent), ref_ent, rtol=1e-4, atol=1e-4)
+
+
+def _np_ppo_loss(logp, old, adv, eps, mask, prox=None, cap=None, c_clip=None):
+    """Independent numpy re-derivation of the decoupled loss
+    (reference math: areal/utils/functional.py:171-235)."""
+    denorm = prox if prox is not None else old
+    ratio = np.exp(logp - denorm)
+    clipped = np.clip(ratio, 1 - eps, 1 + eps)
+    l1, l2 = -adv * ratio, -adv * clipped
+    loss = np.maximum(l1, l2)
+    if c_clip is not None:
+        l3 = np.sign(adv) * c_clip * adv
+        loss = np.where(adv < 0, np.minimum(loss, l3), loss)
+    if prox is not None:
+        w = np.exp(prox - old)
+        wmask = (w <= cap) if cap is not None else np.ones_like(w, bool)
+        wmask &= mask > 0
+        loss = loss * np.where(wmask, w, 0.0)
+    return np.sum(loss * mask)
+
+
+@pytest.mark.parametrize("decoupled", [False, True])
+@pytest.mark.parametrize("c_clip", [None, 3.0])
+def test_ppo_actor_loss(decoupled, c_clip):
+    rng = np.random.default_rng(3)
+    T = 50
+    logp = rng.normal(scale=0.5, size=T).astype(np.float32)
+    old = logp + rng.normal(scale=0.2, size=T).astype(np.float32)
+    prox = (logp + rng.normal(scale=0.1, size=T).astype(np.float32)) if decoupled else None
+    adv = rng.normal(size=T).astype(np.float32)
+    mask = (rng.random(T) > 0.3).astype(np.float32)
+    loss, stats = ppo_actor_loss_fn(
+        logp, old, adv, 0.2, mask,
+        c_clip=c_clip, proximal_logprobs=prox, behav_imp_weight_cap=5.0,
+    )
+    ref = _np_ppo_loss(logp, old, adv, 0.2, mask, prox, 5.0, c_clip)
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+    assert float(stats["n_valid_tokens"]) == mask.sum()
+
+
+def test_grpo_loss_runs_and_masks():
+    rng = np.random.default_rng(4)
+    T, V = 12, 29
+    logits = rng.normal(size=(T, V)).astype(np.float32)
+    batch = {
+        "input_ids": rng.integers(0, V, T),
+        "loss_mask": (rng.random(T) > 0.4).astype(np.float32),
+        "logprobs": rng.normal(scale=0.1, size=T).astype(np.float32),
+        "prox_logp": rng.normal(scale=0.1, size=T).astype(np.float32),
+        "advantages": rng.normal(size=T).astype(np.float32),
+    }
+    loss, stats = grpo_loss_fn(logits, batch, eps_clip=0.2)
+    assert np.isfinite(float(loss))
+    # zero mask => zero loss
+    batch["loss_mask"] = np.zeros(T, np.float32)
+    loss0, _ = grpo_loss_fn(logits, batch, eps_clip=0.2)
+    assert float(loss0) == 0.0
+
+
+def test_critic_and_sft_and_rw_losses():
+    rng = np.random.default_rng(5)
+    T, V = 10, 17
+    v = rng.normal(size=T).astype(np.float32)
+    ov = v + rng.normal(scale=0.05, size=T).astype(np.float32)
+    ret = rng.normal(size=T).astype(np.float32)
+    mask = np.ones(T, np.float32)
+    loss, _ = ppo_critic_loss_fn(v, ov, ret, mask, eps_clip_value=0.2)
+    ref_unclipped = 0.5 * np.sum(np.square(v - ret))
+    assert float(loss) >= ref_unclipped - 1e-5  # clipping takes the max
+
+    logits = rng.normal(size=(T, V)).astype(np.float32)
+    batch = {"input_ids": rng.integers(0, V, T), "loss_mask": mask}
+    sloss, sstats = sft_loss_fn(logits, batch)
+    assert float(sloss) > 0 and float(sstats["n_valid_tokens"]) == T
+
+    ch, rj = rng.normal(size=4).astype(np.float32), rng.normal(size=4).astype(np.float32)
+    rloss, rstats = pairwise_reward_loss_fn(ch, rj)
+    ref = -np.sum(np.log(1 / (1 + np.exp(-(ch - rj)))))
+    np.testing.assert_allclose(float(rloss), ref, rtol=1e-4)
+
+
+def test_kl_and_norm_utils():
+    rng = np.random.default_rng(6)
+    a, b = rng.normal(size=20).astype(np.float32), rng.normal(size=20).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(kl_estimate(a, b, "k1")), a - b, rtol=1e-6)
+    k3 = np.asarray(kl_estimate(a, b, "k3"))
+    assert np.all(k3 >= -1e-6)  # k3 is non-negative
+    x = rng.normal(loc=3.0, scale=2.0, size=100).astype(np.float32)
+    mask = np.ones_like(x)
+    y = np.asarray(masked_normalize(x, mask))
+    assert abs(y.mean()) < 1e-3 and abs(y.std() - 1.0) < 1e-2
