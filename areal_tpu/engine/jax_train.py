@@ -1,0 +1,495 @@
+"""JaxTrainEngine — the SPMD training backend.
+
+Capability counterpart of BOTH reference train engines: FSDPEngine
+(areal/engine/fsdp_engine.py:64) and MegatronEngine
+(areal/engine/megatron_engine.py:67).  One engine suffices on TPU because a
+single GSPMD mesh (dp, fsdp, sp, tp) subsumes FSDP2 sharding, megatron TP/SP
+and Ulysses:
+
+- "create_process_group" = build the Mesh (no NCCL group zoo).
+- "parallelize_model" = device_put params with PartitionSpecs from
+  `areal_tpu.models.param_partition_specs`; XLA inserts all collectives.
+- train_batch = ONE jit per (loss_fn, shape-signature): micro-batch gradient
+  accumulation is a `lax.scan` over a stacked [n_mb, rows, row_len] batch —
+  the whole optimizer step (fwd, bwd, accumulate, clip, adamw, lr schedule)
+  is a single XLA program with donated state (the reference needs a python
+  loop over micro-batches + DTensor full_tensor gathers).
+- Batches use the row-packed layout (utils/data.py `pack_into_rows`):
+  packed like the reference's flat layout (base_hf_engine.py:257
+  prepare_mb_list) yet shardable over (dp, fsdp) with static shapes.
+
+Loss functions follow the reference's protocol (engine_api.py train_batch):
+`loss_fn(logits, mb) -> (sum_loss, stats_sums)`, `loss_weight_fn(batch) ->
+float`; gradients are globally normalised by the summed weight across all
+micro-batches (fsdp_engine.py:499-606's global loss-weight normalisation).
+loss_fn must be a *stable* callable — the compiled step is cached per
+(id(loss_fn), shapes).
+"""
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.api.config import TrainEngineConfig
+from areal_tpu.api.engine import TrainEngine
+from areal_tpu.api.io_struct import (
+    FinetuneSpec,
+    SaveLoadMeta,
+    WeightUpdateMeta,
+)
+from areal_tpu.models import (
+    TransformerConfig,
+    forward as model_forward,
+    init_params,
+    param_partition_specs,
+)
+from areal_tpu.models.hf import load_hf_params, save_hf_checkpoint
+from areal_tpu.parallel import batch_spec, build_mesh, mesh_from_alloc, shard_pytree
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.data import (
+    RowPackedBatch,
+    pack_into_rows,
+    unpack_rows,
+)
+from areal_tpu.utils.datapack import round_up_to_bucket
+from areal_tpu.ops.functional import gather_logprobs
+
+logger = logging.getLogger("jax_train")
+
+
+def _logp_hook(logits, mb):
+    """Default forward hook: next-token logprobs at predictor positions
+    (the reference's compute_logp convention, ppo/actor.py:52)."""
+    labels = jnp.roll(mb["input_ids"], -1, axis=-1)
+    return gather_logprobs(logits, labels)
+
+
+class JaxTrainEngine(TrainEngine):
+    def __init__(
+        self,
+        config: TrainEngineConfig,
+        model_config: Optional[TransformerConfig] = None,
+    ):
+        self.config = config
+        self.model_config = model_config
+        self.mesh = None
+        self.params = None
+        self.opt_state = None
+        self.step_count = 0
+        self._version = 0
+        self._optimizer = None
+        self._schedule = None
+        self._param_shardings = None
+        self._train_step_cache: Dict[Tuple, Callable] = {}
+        self._forward_cache: Dict[Tuple, Callable] = {}
+        self._ft_spec: Optional[FinetuneSpec] = None
+        self.initialized = False
+
+    # ------------------------------------------------------------------
+    # setup
+    # ------------------------------------------------------------------
+
+    def create_process_group(self, alloc_mode=None) -> None:
+        if self.mesh is not None:
+            return
+        if alloc_mode is not None and getattr(alloc_mode, "train", None):
+            self.mesh = mesh_from_alloc(alloc_mode.train)
+        else:
+            m = self.config.mesh
+            self.mesh = build_mesh(
+                dp=m.data_parallel_size,
+                fsdp=m.fsdp_parallel_size,
+                sp=m.sequence_parallel_size,
+                tp=m.tensor_parallel_size,
+            )
+        logger.info(f"mesh: {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}")
+
+    def initialize(
+        self,
+        addr: Optional[str] = None,
+        ft_spec: Optional[FinetuneSpec] = None,
+    ) -> None:
+        self.create_process_group()
+        self._ft_spec = ft_spec
+        cfg = self.config
+
+        if cfg.path and not cfg.init_from_scratch:
+            host_params, mc = load_hf_params(
+                cfg.path, self.model_config, dtype=cfg.param_dtype
+            )
+            self.model_config = mc
+        else:
+            if self.model_config is None:
+                raise ValueError("init_from_scratch requires model_config")
+            host_params = init_params(
+                self.model_config.replace(param_dtype=cfg.param_dtype),
+                jax.random.PRNGKey(0),
+            )
+        self.model_config = self.model_config.replace(
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            remat=cfg.gradient_checkpointing,
+        )
+        specs = param_partition_specs(self.model_config)
+        self._param_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.params = shard_pytree(self.mesh, host_params, specs)
+
+        if cfg.optimizer is not None:
+            self._build_optimizer(ft_spec)
+        self.initialized = True
+        n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
+        logger.info(f"initialized {n / 1e6:.1f}M params on mesh {self.mesh.shape}")
+
+    def _build_optimizer(self, ft_spec: Optional[FinetuneSpec]) -> None:
+        oc = self.config.optimizer
+        total_steps = ft_spec.total_train_steps if ft_spec is not None else 1_000_000
+        warmup = int(oc.warmup_steps_proportion * total_steps)
+        peak, floor = oc.lr, oc.lr * oc.min_lr_ratio
+        if oc.lr_scheduler_type == "cosine":
+            decay = optax.cosine_decay_schedule(
+                peak, max(1, total_steps - warmup), alpha=oc.min_lr_ratio
+            )
+        elif oc.lr_scheduler_type == "linear":
+            decay = optax.linear_schedule(peak, floor, max(1, total_steps - warmup))
+        else:
+            decay = optax.constant_schedule(peak)
+        if warmup > 0:
+            self._schedule = optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, warmup), decay], [warmup]
+            )
+        else:
+            self._schedule = decay
+        wd_mask = jax.tree_util.tree_map(lambda p: p.ndim >= 2, self.params)
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(oc.gradient_clipping),
+            optax.adamw(
+                learning_rate=self._schedule,
+                b1=oc.beta1,
+                b2=oc.beta2,
+                eps=oc.eps,
+                weight_decay=oc.weight_decay,
+                mask=wd_mask,
+            ),
+        )
+        # Eager init: zeros_like inherits each param's NamedSharding for
+        # mu/nu, and scalar counters stay uncommitted (placeable by jit);
+        # a jitted init without out_shardings would commit everything to
+        # one device and clash with the sharded params inside train_step.
+        with self.mesh:
+            self.opt_state = self._optimizer.init(self.params)
+
+    def destroy(self) -> None:
+        self.params = None
+        self.opt_state = None
+        self._train_step_cache.clear()
+        self._forward_cache.clear()
+        self.initialized = False
+
+    # ------------------------------------------------------------------
+    # data-parallel topology (single-controller: one process owns the mesh)
+    # ------------------------------------------------------------------
+
+    @property
+    def data_parallel_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def data_parallel_world_size(self) -> int:
+        return jax.process_count()
+
+    def is_data_parallel_head(self) -> bool:
+        return jax.process_index() == 0
+
+    def current_data_parallel_head(self) -> int:
+        return 0
+
+    # ------------------------------------------------------------------
+    # batch preparation
+    # ------------------------------------------------------------------
+
+    def _row_len(self, batch: Dict[str, np.ndarray]) -> int:
+        lens = batch["attention_mask"].astype(np.int64).sum(-1)
+        longest = int(lens.max()) if lens.size else 1
+        return round_up_to_bucket(
+            longest, self.config.pack_length_quantum, self.config.max_pack_length
+        )
+
+    def _prepare_rows(
+        self, batch: Dict[str, np.ndarray], n_mbs: int
+    ) -> Tuple[RowPackedBatch, Dict[str, np.ndarray], int]:
+        """Row-pack a padded batch; rows divisible by n_mbs * dp * fsdp."""
+        row_len = self._row_len(batch)
+        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        rp = pack_into_rows(batch, row_len, rows_multiple=n_mbs * dp)
+        data = dict(rp.data)
+        data["input_ids"] = data["input_ids"].astype(np.int32)
+        # filler rows/tokens must never contribute to the loss
+        if "loss_mask" in data:
+            data["loss_mask"] = data["loss_mask"] * (data["segment_ids"] >= 0)
+        return rp, data, row_len
+
+    def _stack_mbs(self, data: Dict[str, np.ndarray], n_mbs: int) -> Dict[str, np.ndarray]:
+        """[R, L] -> [n_mbs, R/n_mbs, L]; rows were FFD-balanced so token
+        counts are roughly even across micro-batches."""
+        out = {}
+        for k, v in data.items():
+            R = v.shape[0]
+            out[k] = v.reshape(n_mbs, R // n_mbs, *v.shape[1:])
+        return out
+
+    def _device_batch(self, data: Dict[str, np.ndarray], stacked: bool):
+        """Shard host arrays: rows over (dp, fsdp), sequence over sp."""
+        spec = batch_spec()
+        if stacked:
+            spec = P(None, *spec)
+        sharding = NamedSharding(self.mesh, spec)
+        return {k: jax.device_put(v, sharding) for k, v in data.items()}
+
+    # ------------------------------------------------------------------
+    # train / eval / forward
+    # ------------------------------------------------------------------
+
+    def _build_train_step(self, loss_fn: Callable):
+        mcfg = self.model_config
+        optimizer = self._optimizer
+
+        def train_step(params, opt_state, batch, total_weight):
+            def mb_loss(p, mb):
+                logits = model_forward(
+                    p, mcfg, mb["input_ids"], mb["positions"], mb["segment_ids"]
+                )
+                loss, stats = loss_fn(logits, mb)
+                return loss / total_weight, stats
+
+            grad_fn = jax.value_and_grad(mb_loss, has_aux=True)
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+
+            def scan_body(carry, mb):
+                grads_acc, loss_acc = carry
+                (loss, stats), grads = grad_fn(params, mb)
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                )
+                return (grads_acc, loss_acc + loss), stats
+
+            (grads, loss), stats = jax.lax.scan(
+                scan_body, (zero_grads, jnp.zeros((), jnp.float32)), batch
+            )
+            stats = jax.tree_util.tree_map(lambda s: jnp.sum(s, axis=0), stats)
+            grad_norm = optax.global_norm(grads)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["grad_norm"] = grad_norm
+            stats["loss"] = loss
+            return new_params, new_opt_state, stats
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def train_batch(
+        self,
+        input_: Dict[str, np.ndarray],
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> Dict[str, float]:
+        assert self.initialized and self._optimizer is not None
+        n_mbs = max(1, self.config.mb_spec.n_mbs)
+        rp, data, row_len = self._prepare_rows(input_, n_mbs)
+        total_weight = float(loss_weight_fn(data))
+        if total_weight <= 0:
+            raise ValueError("loss_weight_fn returned non-positive total weight")
+        stacked = self._stack_mbs(data, n_mbs)
+        dev_batch = self._device_batch(stacked, stacked=True)
+
+        # the callable itself is part of the key: the strong reference keeps
+        # it alive, so CPython cannot reuse its address for a different fn
+        key = (loss_fn, n_mbs, row_len, stacked["input_ids"].shape[1])
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = self._build_train_step(loss_fn)
+        step_fn = self._train_step_cache[key]
+
+        t0 = time.perf_counter()
+        with self.mesh:
+            self.params, self.opt_state, stats = step_fn(
+                self.params, self.opt_state, dev_batch, jnp.float32(total_weight)
+            )
+        stats = {k: float(v) for k, v in stats.items()}
+        # optax evaluated the schedule at the pre-increment count
+        stats["lr"] = float(self._schedule(self.step_count))
+        self.step_count += 1
+        stats["total_loss_weight"] = total_weight
+        stats["step_time"] = time.perf_counter() - t0
+        return stats
+
+    def eval_batch(
+        self,
+        input_: Dict[str, np.ndarray],
+        loss_fn: Callable,
+        loss_weight_fn: Callable,
+    ) -> Dict[str, float]:
+        assert self.initialized
+        rp, data, row_len = self._prepare_rows(input_, 1)
+        total_weight = float(loss_weight_fn(data))
+        dev_batch = self._device_batch(data, stacked=False)
+        mcfg = self.model_config
+
+        key = ("eval", loss_fn, row_len, data["input_ids"].shape[0])
+        if key not in self._forward_cache:
+
+            def eval_step(params, batch):
+                logits = model_forward(
+                    params,
+                    mcfg,
+                    batch["input_ids"],
+                    batch["positions"],
+                    batch["segment_ids"],
+                )
+                return loss_fn(logits, batch)
+
+            self._forward_cache[key] = jax.jit(eval_step)
+        with self.mesh:
+            loss, stats = self._forward_cache[key](self.params, dev_batch)
+        out = {k: float(v) for k, v in stats.items()}
+        out["loss"] = float(loss) / max(total_weight, 1e-8)
+        return out
+
+    def forward(
+        self,
+        input_: Dict[str, np.ndarray],
+        output_key: str = "logprobs",
+        post_hook: Optional[Callable] = None,
+        aggregate_fn: Callable = None,
+    ) -> np.ndarray:
+        """No-grad forward; returns a padded [B, L] array aligned with the
+        input batch (default: next-token logprobs at predictor positions,
+        the reference's compute_logp convention)."""
+        assert self.initialized
+        if output_key != "logprobs":
+            raise NotImplementedError(
+                "forward() returns per-token arrays directly; output_key "
+                "selection does not apply to this engine"
+            )
+        if aggregate_fn is not None:
+            raise NotImplementedError(
+                "forward() runs one fused program — there are no per-microbatch "
+                "outputs to aggregate; post-process the returned array instead"
+            )
+        rp, data, row_len = self._prepare_rows(input_, 1)
+        dev_batch = self._device_batch(data, stacked=False)
+        mcfg = self.model_config
+
+        if post_hook is None:
+            post_hook = _logp_hook
+        key = ("fwd", post_hook, row_len, data["input_ids"].shape[0])
+        if key not in self._forward_cache:
+
+            def fwd_step(params, batch):
+                logits = model_forward(
+                    params,
+                    mcfg,
+                    batch["input_ids"],
+                    batch["positions"],
+                    batch["segment_ids"],
+                )
+                return post_hook(logits, batch)
+
+            self._forward_cache[key] = jax.jit(fwd_step)
+        with self.mesh:
+            rows_out = np.asarray(self._forward_cache[key](self.params, dev_batch))
+        B, L = input_["attention_mask"].shape
+        return unpack_rows(rp, rows_out, B, L)
+
+    # ------------------------------------------------------------------
+    # weights
+    # ------------------------------------------------------------------
+
+    def _host_params(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def update_weights(self, meta: WeightUpdateMeta) -> None:
+        """Disk path (reference: fsdp_engine.py:403-425): dump an HF
+        checkpoint, then publish the save timestamp for the version so
+        inference clients/servers can reload."""
+        if meta.type != "disk":
+            raise NotImplementedError("transfer path lands with the gen server")
+        path = os.path.join(meta.path, str(self._version))
+        save_hf_checkpoint(
+            self._host_params(),
+            self.model_config,
+            path,
+            save_dtype="bfloat16",
+            tokenizer_src=self.config.path or None,
+        )
+        name_resolve.add(
+            names.update_weights_from_disk(
+                meta.experiment_name, meta.trial_name, self._version
+            ),
+            str(time.time_ns()),
+            replace=True,
+        )
+
+    def save(self, meta: SaveLoadMeta) -> None:
+        save_hf_checkpoint(
+            self._host_params(),
+            self.model_config,
+            meta.path,
+            save_dtype="bfloat16" if not meta.with_optim else "float32",
+            tokenizer_src=self.config.path or None,
+        )
+        if meta.with_optim and self.opt_state is not None:
+            leaves = jax.tree_util.tree_leaves(self.opt_state)
+            np.savez(
+                os.path.join(meta.path, "optimizer_state.npz"),
+                step=self.step_count,
+                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+            )
+
+    def load(self, meta: SaveLoadMeta) -> None:
+        host_params, mc = load_hf_params(
+            meta.path, self.model_config, dtype=self.config.param_dtype
+        )
+        self.model_config = mc.replace(
+            dtype=self.config.dtype,
+            param_dtype=self.config.param_dtype,
+            remat=self.config.gradient_checkpointing,
+        )
+        self.params = shard_pytree(
+            self.mesh, host_params, param_partition_specs(self.model_config)
+        )
+        opt_path = os.path.join(meta.path, "optimizer_state.npz")
+        if meta.with_optim and os.path.exists(opt_path):
+            saved = np.load(opt_path)
+            self.step_count = int(saved["step"])
+            live_leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
+            restored = []
+            for i, live in enumerate(live_leaves):
+                arr = jnp.asarray(saved[f"leaf_{i}"])
+                # shard like the live leaf; leave scalars uncommitted so jit
+                # can replicate them alongside any param sharding
+                if getattr(live, "ndim", 0) >= 1:
+                    arr = jax.device_put(arr, live.sharding)
+                restored.append(arr)
+            self.opt_state = jax.tree_util.tree_unflatten(treedef, restored)
+
+    def step_lr_scheduler(self) -> None:
+        # the schedule is step-indexed inside the jitted update; nothing to do
+        pass
+
+    def set_version(self, version: int) -> None:
+        self._version = version
+
+    def get_version(self) -> int:
+        return self._version

@@ -167,7 +167,7 @@ def grpo_loss_fn(
     batch keys (flat [T]): input_ids, loss_mask, logprobs (behaviour),
     advantages, and optionally prox_logp.
     """
-    labels = jnp.roll(batch["input_ids"], -1)
+    labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     loss_mask = batch["loss_mask"].astype(jnp.float32)
     logits = logits.astype(jnp.float32) / temperature
     logprobs, entropy = gather_logprobs_entropy(logits, labels)
@@ -225,7 +225,7 @@ def sft_loss_fn(
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Token cross-entropy over next-token targets, masked sum
     (reference: areal/engine/sft/lm_engine.py)."""
-    labels = jnp.roll(batch["input_ids"], -1)
+    labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     mask = batch["loss_mask"].astype(jnp.float32)
     logprobs = gather_logprobs(logits, labels)
     loss = -jnp.sum(logprobs * mask)

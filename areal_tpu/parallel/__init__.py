@@ -1,0 +1,19 @@
+from areal_tpu.parallel.mesh import (
+    MeshAxes,
+    batch_spec,
+    build_mesh,
+    mesh_from_alloc,
+    named_sharding,
+    replicated,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshAxes",
+    "build_mesh",
+    "mesh_from_alloc",
+    "batch_spec",
+    "named_sharding",
+    "replicated",
+    "shard_pytree",
+]

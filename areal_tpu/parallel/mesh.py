@@ -1,0 +1,94 @@
+"""Device-mesh construction and sharding helpers.
+
+TPU-native counterpart of the reference's process-group plumbing: what FSDP2
+DeviceMesh setup (areal/utils/fsdp/parallel.py:87), Megatron 5-D initialization
+(areal/engine/megatron_engine.py:176-237) and the legacy ParallelGrid
+(realhf/base/topology.py:369) achieve with explicit NCCL groups is here a
+single `jax.sharding.Mesh` over axes (dp, fsdp, sp, tp); GSPMD derives every
+collective from PartitionSpecs, so there is no group bookkeeping to port.
+
+Axis semantics:
+- dp: pure data parallel (replicated params, sharded batch rows)
+- fsdp: ZeRO-style — params/optimizer sharded here AND batch rows sharded
+  (the reference's dp axis under FSDP2 plays both roles too)
+- sp: sequence dimension of activations (Ulysses/CP-equivalent; GSPMD
+  inserts the head/seq all-to-alls the reference hand-writes in
+  areal/utils/ulysses.py)
+- tp: tensor parallel (megatron column/row split via the model's specs)
+"""
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.api.alloc import ParallelStrategy
+
+MeshAxes = ("dp", "fsdp", "sp", "tp")
+
+
+def build_mesh(
+    dp: int = 1,
+    fsdp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Build the 4-axis mesh. Axis order puts tp innermost so tensor-parallel
+    collectives ride the fastest ICI links."""
+    if devices is None:
+        devices = jax.devices()
+    need = dp * fsdp * sp * tp
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    dev = np.asarray(devices[:need]).reshape(dp, fsdp, sp, tp)
+    return Mesh(dev, MeshAxes)
+
+
+def mesh_from_alloc(
+    strategy: ParallelStrategy, devices: Optional[Sequence[Any]] = None
+) -> Mesh:
+    """Map an allocation-DSL ParallelStrategy onto mesh axes.
+
+    The DSL's context/sequence parallel sizes both land on the `sp` axis
+    (they are the same axis on TPU: shard the sequence dim, let GSPMD insert
+    gathers); pipeline parallel is intentionally not an axis — GSPMD+ICI
+    covers TPU slices without PP (SURVEY.md §7).
+    """
+    if strategy.pipeline_parallel_size > 1:
+        raise NotImplementedError(
+            "pipeline parallelism is not a TPU mesh axis; use fsdp/tp/sp"
+        )
+    sp = strategy.sequence_parallel_size * strategy.context_parallel_size
+    return build_mesh(
+        dp=strategy.data_parallel_size,
+        fsdp=strategy.fsdp_parallel_size,
+        sp=sp,
+        tp=strategy.tensor_parallel_size,
+        devices=devices,
+    )
+
+
+def batch_spec(per_token: bool = True) -> P:
+    """PartitionSpec for [R, L(, ...)] batch arrays: rows over (dp, fsdp),
+    sequence over sp."""
+    if per_token:
+        return P(("dp", "fsdp"), "sp")
+    return P(("dp", "fsdp"))
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_pytree(mesh: Mesh, tree: Any, specs: Any) -> Any:
+    """device_put every leaf with its NamedSharding (specs mirrors tree)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

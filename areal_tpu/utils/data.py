@@ -394,3 +394,114 @@ def to_jax(batch: Dict[str, np.ndarray], device=None):
 
 def tree_bytes(batch: Dict[str, np.ndarray]) -> int:
     return sum(v.nbytes for v in batch.values() if isinstance(v, np.ndarray))
+
+
+# ---------------------------------------------------------------------------
+# Row-packed representation (TPU training layout)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RowPackedBatch:
+    """Sequences FFD-packed into fixed-length rows `[R, row_len]`.
+
+    The TPU-first evolution of the reference's flat packed layout
+    (areal/utils/data.py:266 pack_tensor_dict): rows are simultaneously
+    *packed* (no FLOPs wasted on per-sequence padding beyond row remainder)
+    and *shardable* over the (dp, fsdp) mesh axes, with static shapes for jit.
+    `segment_ids` isolate sequences within a row for attention; `positions`
+    restart at 0 per sequence for RoPE.
+
+    `placements[r]` lists `(orig_index, length)` in order for row r, enabling
+    exact inverse mapping of per-token outputs.
+    """
+
+    data: Dict[str, np.ndarray]
+    placements: List[List[tuple]]
+    row_len: int
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.placements)
+
+
+def pack_into_rows(
+    batch: Dict[str, np.ndarray],
+    row_len: int,
+    rows_multiple: int = 1,
+) -> RowPackedBatch:
+    """Padded [B, L] batch -> RowPackedBatch.
+
+    First-fit-decreasing over rows of capacity `row_len` (the balancing role
+    of the reference's ffd_allocate, datapack.py); the row count is padded up
+    to a multiple of `rows_multiple` (dp-shard divisibility) with empty rows.
+    """
+    mask = batch["attention_mask"].astype(bool)
+    B, L = mask.shape
+    lens = mask.sum(-1).astype(np.int64)
+    if lens.max(initial=0) > row_len:
+        raise ValueError(
+            f"sequence of length {int(lens.max())} exceeds row_len {row_len}"
+        )
+    order = np.argsort(-lens, kind="stable")
+    rows: List[List[tuple]] = []
+    space: List[int] = []
+    for i in order:
+        n = int(lens[i])
+        if n == 0:
+            continue
+        placed = False
+        for r in range(len(rows)):
+            if space[r] >= n:
+                rows[r].append((int(i), n))
+                space[r] -= n
+                placed = True
+                break
+        if not placed:
+            rows.append([(int(i), n)])
+            space.append(row_len - n)
+    R = max(1, len(rows))
+    if rows_multiple > 1:
+        R = ((R + rows_multiple - 1) // rows_multiple) * rows_multiple
+    while len(rows) < R:
+        rows.append([])
+
+    token_keys = [
+        k
+        for k, arr in batch.items()
+        if k != "attention_mask" and _is_per_token(k, arr, B, L)
+    ]
+    out: Dict[str, np.ndarray] = {}
+    for k in token_keys:
+        arr = batch[k]
+        buf = np.zeros((R, row_len, *arr.shape[2:]), dtype=arr.dtype)
+        for r, row in enumerate(rows):
+            ofs = 0
+            for i, n in row:
+                buf[r, ofs : ofs + n] = arr[i, :n]
+                ofs += n
+        out[k] = buf
+    seg = np.full((R, row_len), -1, dtype=np.int32)
+    pos = np.zeros((R, row_len), dtype=np.int32)
+    for r, row in enumerate(rows):
+        ofs = 0
+        for s, (i, n) in enumerate(row):
+            seg[r, ofs : ofs + n] = s
+            pos[r, ofs : ofs + n] = np.arange(n, dtype=np.int32)
+            ofs += n
+    out["segment_ids"] = seg
+    out["positions"] = pos
+    return RowPackedBatch(data=out, placements=rows, row_len=row_len)
+
+
+def unpack_rows(
+    rp: RowPackedBatch, row_outputs: np.ndarray, batch_size: int, max_len: int
+) -> np.ndarray:
+    """Per-token row outputs [R, row_len, ...] -> padded [B, max_len, ...]."""
+    out = np.zeros((batch_size, max_len, *row_outputs.shape[2:]), row_outputs.dtype)
+    for r, row in enumerate(rp.placements):
+        ofs = 0
+        for i, n in row:
+            out[i, :n] = row_outputs[r, ofs : ofs + n]
+            ofs += n
+    return out

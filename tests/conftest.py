@@ -7,7 +7,9 @@ virtual CPU mesh; real-TPU benchmarks live in bench.py, not tests.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient environment selects a TPU platform —
+# tests exercise distributed sharding on 8 virtual devices
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +17,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# A site-installed TPU plugin may have forced jax_platforms at interpreter
+# boot (overriding the env var), so re-force CPU at the config level too.
+jax.config.update("jax_platforms", "cpu")
 
 # Numerics tests compare against fp32 torch references; XLA:CPU's default
 # (lower) einsum precision would drown parity in ~1e-3 noise.
