@@ -157,6 +157,9 @@ class PPOActorConfig(TrainEngineConfig):
     overlong_reward_penalty: bool = False
     overlong_tokens: int = 0
     overlong_penalty_factor: float = 0.0
+    # generation budget the overlong penalty is measured against (DAPO);
+    # must equal the rollout's gconfig.max_new_tokens
+    max_new_tokens: int = 0
     mask_no_eos_with_zero: bool = False
     # KL & advantages
     kl_ctl: float = 0.0

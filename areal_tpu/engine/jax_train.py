@@ -86,11 +86,14 @@ class JaxTrainEngine(TrainEngine):
         self._version = 0
         self._optimizer = None
         self._schedule = None
-        self._param_shardings = None
         self._train_step_cache: Dict[Tuple, Callable] = {}
         self._forward_cache: Dict[Tuple, Callable] = {}
         self._ft_spec: Optional[FinetuneSpec] = None
         self.initialized = False
+        # the jitted step functions call self._model_fn(params, cfg, ids,
+        # positions, segment_ids); value/reward engines override it to return
+        # per-token values instead of logits
+        self._model_fn = model_forward
 
     # ------------------------------------------------------------------
     # setup
@@ -138,11 +141,6 @@ class JaxTrainEngine(TrainEngine):
             remat=cfg.gradient_checkpointing,
         )
         specs = param_partition_specs(self.model_config)
-        self._param_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s),
-            specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
         self.params = shard_pytree(self.mesh, host_params, specs)
 
         if cfg.optimizer is not None:
@@ -263,10 +261,11 @@ class JaxTrainEngine(TrainEngine):
     def _build_train_step(self, loss_fn: Callable):
         mcfg = self.model_config
         optimizer = self._optimizer
+        model_fn = self._model_fn
 
         def train_step(params, opt_state, batch, total_weight):
             def mb_loss(p, mb):
-                logits = model_forward(
+                logits = model_fn(
                     p, mcfg, mb["input_ids"], mb["positions"], mb["segment_ids"]
                 )
                 loss, stats = loss_fn(logits, mb)
@@ -341,23 +340,36 @@ class JaxTrainEngine(TrainEngine):
         loss_weight_fn: Callable,
     ) -> Dict[str, float]:
         assert self.initialized
-        rp, data, row_len = self._prepare_rows(input_, 1)
+        # honor mb_spec: eval must not materialise logits for rows the train
+        # path would split across micro-batches
+        n_mbs = max(1, self.config.mb_spec.n_mbs)
+        rp, data, row_len = self._prepare_rows(input_, n_mbs)
         total_weight = float(loss_weight_fn(data))
-        dev_batch = self._device_batch(data, stacked=False)
+        stacked = self._stack_mbs(data, n_mbs)
+        dev_batch = self._device_batch(stacked, stacked=True)
         mcfg = self.model_config
 
-        key = ("eval", loss_fn, row_len, data["input_ids"].shape[0])
+        key = ("eval", loss_fn, n_mbs, row_len, stacked["input_ids"].shape[1])
         if key not in self._forward_cache:
 
+            model_fn = self._model_fn
+
             def eval_step(params, batch):
-                logits = model_forward(
-                    params,
-                    mcfg,
-                    batch["input_ids"],
-                    batch["positions"],
-                    batch["segment_ids"],
+                def mb_loss(carry, mb):
+                    logits = model_fn(
+                        params,
+                        mcfg,
+                        mb["input_ids"],
+                        mb["positions"],
+                        mb["segment_ids"],
+                    )
+                    loss, stats = loss_fn(logits, mb)
+                    return carry + loss, stats
+
+                loss, stats = jax.lax.scan(mb_loss, jnp.zeros(()), batch)
+                return loss, jax.tree_util.tree_map(
+                    lambda s: jnp.sum(s, axis=0), stats
                 )
-                return loss_fn(logits, batch)
 
             self._forward_cache[key] = jax.jit(eval_step)
         with self.mesh:
@@ -396,8 +408,10 @@ class JaxTrainEngine(TrainEngine):
         key = ("fwd", post_hook, row_len, data["input_ids"].shape[0])
         if key not in self._forward_cache:
 
+            model_fn = self._model_fn
+
             def fwd_step(params, batch):
-                logits = model_forward(
+                logits = model_fn(
                     params,
                     mcfg,
                     batch["input_ids"],

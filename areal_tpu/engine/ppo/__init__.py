@@ -1,0 +1,4 @@
+from areal_tpu.engine.ppo.actor import JaxPPOActor, PPOActor
+from areal_tpu.engine.ppo.critic import JaxPPOCritic
+
+__all__ = ["PPOActor", "JaxPPOActor", "JaxPPOCritic"]

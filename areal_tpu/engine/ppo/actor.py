@@ -1,0 +1,254 @@
+"""PPO/GRPO actor.
+
+Behavioral counterpart of the reference's `PPOActor`
+(areal/engine/ppo/actor.py:25): compute_logp (:52), compute_advantages (:72 —
+reward scale/clip/norm, KL-regularized token rewards, GAE, group
+normalisation) and ppo_update (:166 — dynamic sampling, minibatch splitting,
+stats).  TPU-first differences:
+
+- GAE runs as the reverse `lax.scan` kernel (areal_tpu/ops/gae.py), jitted
+  over the whole padded batch — replacing both the reference's CUDA `cugae`
+  and its python fallback loop.
+- Alignment convention: trajectories arrive token-aligned (arr[t] describes
+  token t, the workflow/inference convention); losses consume
+  predictor-aligned arrays (arr[t] describes token t+1).
+  `compute_advantages` performs that shift ONCE, explicitly — everything it
+  writes back (advantages, logprobs, prox_logp, loss_mask) is
+  predictor-aligned, matching what `grpo_loss_fn` and `engine.forward`'s
+  logprob hook produce.
+"""
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.config import PPOActorConfig
+from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.ops.functional import grpo_loss_fn
+from areal_tpu.ops.gae import gae_padded
+from areal_tpu.utils import logging, stats
+from areal_tpu.utils.data import Normalization, split_padded_tensor_dict_into_mb_list
+
+logger = logging.getLogger("ppo.actor")
+
+
+def _roll_back(arr: np.ndarray) -> np.ndarray:
+    """token-aligned [B, L] -> predictor-aligned (arr[t] <- arr[t+1])."""
+    return np.roll(arr, -1, axis=-1)
+
+
+class PPOActor:
+    """Algorithm layer over any TrainEngine (reference: actor.py:25)."""
+
+    def __init__(self, config: PPOActorConfig, engine):
+        self.config = config
+        self.engine = engine
+        if config.adv_norm is not None:
+            # NormConfig.group_size overrides when set; default to the GRPO
+            # group size so the common case needs no duplication
+            norm_group = (
+                config.adv_norm.group_size
+                if config.adv_norm.group_size > 1
+                else config.group_size
+            )
+            self.adv_norm = Normalization(
+                mean_level=config.adv_norm.mean_level,
+                std_level=config.adv_norm.std_level,
+                group_size=norm_group,
+                eps=config.adv_norm.eps,
+            )
+        else:
+            self.adv_norm = None
+        self.reward_norm = (
+            Normalization(
+                mean_level="group",
+                std_level="group",
+                group_size=config.group_size,
+            )
+            if config.group_reward_norm
+            else None
+        )
+
+    # ------------------------------------------------------------------
+
+    def compute_logp(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Recompute current-policy logprobs (predictor-aligned [B, L]);
+        the proximal policy of the decoupled objective."""
+        temp = self.config.temperature
+
+        def hook(logits, mb):
+            import jax.numpy as jnp
+
+            from areal_tpu.ops.functional import gather_logprobs
+
+            labels = jnp.roll(mb["input_ids"], -1, axis=-1)
+            return gather_logprobs(logits.astype(jnp.float32) / temp, labels)
+
+        if not hasattr(self, "_logp_hook"):
+            self._logp_hook = hook
+        return self.engine.forward(batch, post_hook=self._logp_hook)
+
+    # ------------------------------------------------------------------
+
+    def compute_advantages(self, batch: Dict[str, np.ndarray]) -> None:
+        """In-place: add predictor-aligned advantages/logprobs/loss_mask
+        (reference: actor.py:72-165)."""
+        cfg = self.config
+        mask_tok = batch["loss_mask"].astype(np.float32)  # token-aligned
+        B, L = mask_tok.shape
+
+        # ---- sequence-level reward shaping (reference: actor.py:80-118)
+        rewards = batch["rewards"].astype(np.float32).copy()
+        seq_lens_completion = mask_tok.sum(-1)
+        if cfg.mask_no_eos_with_zero and "no_eos" in batch:
+            rewards = np.where(batch["no_eos"].astype(bool), 0.0, rewards)
+        if cfg.overlong_reward_penalty and cfg.overlong_tokens > 0:
+            # DAPO soft length penalty measured against the *configured*
+            # generation budget, not the batch's padded width (reference:
+            # actor.py:84-89 uses max_new_tokens)
+            if cfg.max_new_tokens <= 0:
+                raise ValueError(
+                    "overlong_reward_penalty requires max_new_tokens to be "
+                    "set to the rollout's generation budget"
+                )
+            overflow = seq_lens_completion - (cfg.max_new_tokens - cfg.overlong_tokens)
+            penalty = np.clip(
+                overflow / cfg.overlong_tokens, 0.0, 1.0
+            ) * cfg.overlong_penalty_factor
+            rewards = rewards - penalty
+        rewards = (rewards + cfg.reward_bias) * cfg.reward_scaling
+        rewards = np.clip(rewards, -cfg.reward_clip, cfg.reward_clip)
+        if self.reward_norm is not None:
+            rewards = self.reward_norm(rewards[:, None])[:, 0]
+
+        # ---- shift to predictor alignment
+        mask = _roll_back(mask_tok)
+        mask[:, -1] = 0.0
+        old_logp = _roll_back(batch["logprobs"].astype(np.float32)) * mask
+        prox_logp = batch.get("prox_logp")  # already predictor-aligned
+
+        # ---- token rewards: KL penalty + terminal reward (actor.py:119-135)
+        tok_rewards = np.zeros((B, L), np.float32)
+        if cfg.kl_ctl > 0 and "ref_logp" in batch:
+            ref = _roll_back(batch["ref_logp"].astype(np.float32)) * mask
+            from areal_tpu.utils.data import KLEstimator
+
+            kl = KLEstimator(cfg.kl_estimator)(old_logp, ref)
+            tok_rewards -= cfg.kl_ctl * kl * mask
+        # terminal reward at the last predictor position of each sequence
+        idx = np.maximum(mask.shape[1] - 1 - np.argmax(mask[:, ::-1], axis=1), 0)
+        has_completion = mask.sum(-1) > 0
+        tok_rewards[np.arange(B), idx] += np.where(has_completion, rewards, 0.0)
+
+        # ---- GAE (values default 0: GRPO / reward-to-go)
+        values = batch.get("values")
+        values = (
+            _roll_back(values.astype(np.float32)) * mask
+            if values is not None
+            else np.zeros((B, L), np.float32)
+        )
+        adv, returns = gae_padded(
+            tok_rewards, values, mask, cfg.discount, cfg.gae_lambda
+        )
+        adv, returns = np.asarray(adv), np.asarray(returns)
+        if self.adv_norm is not None:
+            adv = self.adv_norm(adv, mask)
+
+        batch["advantages"] = adv.astype(np.float32)
+        batch["returns"] = returns.astype(np.float32)
+        batch["logprobs"] = old_logp.astype(np.float32)
+        batch["loss_mask"] = mask.astype(np.float32)
+        batch["tot_rewards"] = rewards.astype(np.float32)
+        if prox_logp is None and cfg.use_decoupled_loss:
+            # without a recompute pass, proximal == behaviour policy
+            batch["prox_logp"] = old_logp.astype(np.float32)
+
+    # ------------------------------------------------------------------
+
+    def _dynamic_filter(self, batch: Dict[str, np.ndarray]) -> Optional[np.ndarray]:
+        """Drop groups whose rewards are all identical — zero advantage,
+        zero gradient (reference: actor.py dynamic sampling)."""
+        g = self.config.group_size
+        r = batch["rewards"].astype(np.float32)
+        B = r.shape[0]
+        if g <= 1 or B % g != 0:
+            return None
+        groups = r.reshape(-1, g)
+        keep_group = ~np.all(np.isclose(groups, groups[:, :1]), axis=1)
+        keep = np.repeat(keep_group, g)
+        if keep.all():
+            return None
+        if not keep.any():
+            logger.warning("dynamic sampling rejected every group; keeping all")
+            return None
+        return np.nonzero(keep)[0]
+
+    def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
+        cfg = self.config
+        if cfg.dynamic_sampling:
+            keep = self._dynamic_filter(batch)
+            if keep is not None:
+                from areal_tpu.utils.data import select_rows
+
+                batch = select_rows(batch, keep)
+
+        loss_keys = [
+            "input_ids", "attention_mask", "loss_mask", "logprobs",
+            "advantages", "prox_logp",
+        ]
+        train_view = {k: batch[k] for k in loss_keys if k in batch}
+        mbs = split_padded_tensor_dict_into_mb_list(
+            train_view, n_mbs=cfg.ppo_n_minibatches
+        )
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = functools.partial(
+                grpo_loss_fn,
+                eps_clip=cfg.eps_clip,
+                c_clip=cfg.c_clip,
+                behav_imp_weight_cap=cfg.behav_imp_weight_cap,
+                temperature=cfg.temperature,
+                use_decoupled_loss=cfg.use_decoupled_loss,
+                eps_clip_higher=cfg.eps_clip_higher,
+            )
+        all_stats = []
+        for mb in mbs.mbs:
+            st = self.engine.train_batch(
+                mb,
+                self._loss_fn,
+                loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
+            )
+            n = max(st.pop("n_valid_tokens", 1.0), 1.0)
+            # sum-reduced stats -> per-token means
+            for k in (
+                "importance_weight", "approx_kl", "clip_ratio",
+                "dual_clip_ratio", "behave_kl", "behave_imp_weight",
+                "entropy", "new_logp", "old_logp",
+            ):
+                if k in st:
+                    st[k] = st[k] / n
+            st["n_tokens"] = n
+            all_stats.append(st)
+            with stats.DEFAULT_TRACKER.scope("ppo_actor"):
+                stats.DEFAULT_TRACKER.scalar(**{
+                    k: v for k, v in st.items() if np.isscalar(v)
+                })
+        return all_stats
+
+
+class JaxPPOActor(JaxTrainEngine):
+    """JaxTrainEngine + PPOActor algorithm surface, mirroring the
+    reference's FSDPPPOActor (actor.py:278)."""
+
+    def __init__(self, config: PPOActorConfig, model_config=None):
+        super().__init__(config, model_config)
+        self.actor = PPOActor(config, self)
+
+    def compute_logp(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        return self.actor.compute_logp(batch)
+
+    def compute_advantages(self, batch: Dict[str, np.ndarray]) -> None:
+        self.actor.compute_advantages(batch)
+
+    def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
+        return self.actor.ppo_update(batch)

@@ -1,0 +1,136 @@
+"""PPO critic — value model with a scalar head over the backbone.
+
+Behavioral counterpart of the reference's `PPOCritic` / `FSDPPPOCritic`
+(areal/engine/ppo/critic.py): compute_values + ppo_update with the clipped
+value loss.  The value head is an extra `[D]` param dotted against the
+final-norm hidden states (replacing the reference's
+AutoModelForTokenClassification-style critic); per-token values flow through
+the same row-packed train path as the actor.
+"""
+
+import functools
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.api.config import PPOCriticConfig
+from areal_tpu.api.io_struct import SaveLoadMeta
+from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.models.transformer import forward_hidden
+from areal_tpu.ops.functional import ppo_critic_loss_fn
+from areal_tpu.utils.data import split_padded_tensor_dict_into_mb_list
+
+
+def _value_forward(params, cfg, input_ids, positions, segment_ids):
+    hidden = forward_hidden(params, cfg, input_ids, positions, segment_ids)
+    head = params["value_head"].astype(hidden.dtype)
+    return jnp.einsum("btd,d->bt", hidden, head)
+
+
+def _value_hook(values, mb):
+    return values.astype(jnp.float32)
+
+
+def critic_loss(values, mb, eps_clip_value):
+    return ppo_critic_loss_fn(
+        values.astype(jnp.float32),
+        mb["values"],
+        mb["returns"],
+        mb["loss_mask"],
+        eps_clip_value=eps_clip_value,
+    )
+
+
+class JaxPPOCritic(JaxTrainEngine):
+    def __init__(self, config: PPOCriticConfig, model_config=None):
+        super().__init__(config, model_config)
+        self._model_fn = _value_forward
+
+    def initialize(self, addr=None, ft_spec=None) -> None:
+        # build the backbone without the optimizer, attach the value head,
+        # then build the optimizer over the full (backbone + head) tree
+        optimizer_cfg = self.config.optimizer
+        self.config.optimizer = None
+        try:
+            super().initialize(addr, ft_spec)
+        finally:
+            self.config.optimizer = optimizer_cfg
+        self.params.pop("lm_head", None)
+        if "value_head" not in self.params:
+            D = self.model_config.hidden_size
+            head = np.zeros(D, dtype=self.config.param_dtype)
+            head_path = (
+                os.path.join(self.config.path, "value_head.npy")
+                if self.config.path
+                else ""
+            )
+            if head_path and os.path.exists(head_path):
+                head = np.load(head_path).astype(self.config.param_dtype)
+            self.params["value_head"] = jax.device_put(
+                jnp.asarray(head),
+                jax.sharding.NamedSharding(self.mesh, P("fsdp")),
+            )
+        if optimizer_cfg is not None:
+            self._build_optimizer(ft_spec)
+
+    def compute_values(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        """Token-level values [B, L] (reference: critic.py compute_values)."""
+        return self.forward(batch, post_hook=_value_hook)
+
+    def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
+        cfg: PPOCriticConfig = self.config
+        keys = ["input_ids", "attention_mask", "loss_mask", "values", "returns"]
+        view = {k: batch[k] for k in keys if k in batch}
+        mbs = split_padded_tensor_dict_into_mb_list(view, n_mbs=cfg.ppo_n_minibatches)
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = functools.partial(
+                critic_loss, eps_clip_value=cfg.value_eps_clip
+            )
+        out = []
+        for mb in mbs.mbs:
+            st = self.train_batch(
+                mb,
+                self._loss_fn,
+                loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
+            )
+            out.append(st)
+        return out
+
+    def save(self, meta: SaveLoadMeta) -> None:
+        # the critic has no lm_head; present a tied config to the HF writer
+        # so the backbone serialises without one
+        head = self.params.pop("value_head")
+        mc = self.model_config
+        self.model_config = mc.replace(tie_word_embeddings=True)
+        try:
+            super().save(meta)
+        finally:
+            self.model_config = mc
+            self.params["value_head"] = head
+        np.save(os.path.join(meta.path, "value_head.npy"), np.asarray(head))
+
+    def load(self, meta: SaveLoadMeta) -> None:
+        head = self.params.get("value_head")
+        mc = self.model_config
+        self.model_config = mc.replace(tie_word_embeddings=True)
+        try:
+            super().load(meta)
+        finally:
+            self.model_config = mc.replace(
+                dtype=self.config.dtype,
+                param_dtype=self.config.param_dtype,
+                remat=self.config.gradient_checkpointing,
+            )
+        self.params.pop("lm_head", None)
+        head_path = os.path.join(meta.path, "value_head.npy")
+        if os.path.exists(head_path):
+            self.params["value_head"] = jax.device_put(
+                jnp.asarray(np.load(head_path).astype(self.config.param_dtype)),
+                jax.sharding.NamedSharding(self.mesh, P("fsdp")),
+            )
+        elif head is not None:
+            self.params["value_head"] = head

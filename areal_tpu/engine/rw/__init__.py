@@ -1,0 +1,3 @@
+from areal_tpu.engine.rw.rw_engine import JaxRewardModelEngine
+
+__all__ = ["JaxRewardModelEngine"]

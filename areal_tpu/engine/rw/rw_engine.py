@@ -1,0 +1,100 @@
+"""Bradley-Terry pairwise reward-model engine.
+
+Behavioral counterpart of the reference's `RWEngine`
+(areal/engine/rw/rw_engine.py): batches interleave (chosen, rejected) rows;
+the score of a sequence is the value head's output at its final token, and
+the loss is -log sigmoid(score_chosen - score_rejected).
+
+Unlike the per-token engines, sequence identity matters for pairing, so this
+engine keeps the padded one-sequence-per-row layout instead of row packing
+(score extraction and pairing stay trivially correct; RW training is not a
+throughput-critical path).
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.engine.ppo.critic import JaxPPOCritic
+from areal_tpu.ops.functional import pairwise_reward_loss_fn
+
+
+def _rw_loss(values, mb):
+    """values [R, L] where rows alternate chosen/rejected and each row holds
+    exactly one sequence (segment 0 tokens)."""
+    valid = mb["segment_ids"] >= 0
+    lens = jnp.sum(valid, axis=-1)
+    idx = jnp.maximum(lens - 1, 0)
+    scores = jnp.take_along_axis(
+        values.astype(jnp.float32), idx[:, None], axis=-1
+    )[:, 0]
+    real = lens > 0  # filler rows from dp padding score nothing
+    chosen, rejected = scores[0::2], scores[1::2]
+    pair_real = real[0::2] & real[1::2]
+    return pairwise_reward_loss_fn(chosen, rejected, pair_mask=pair_real)
+
+
+class JaxRewardModelEngine(JaxPPOCritic):
+    def _prepare_rows(self, batch, n_mbs):
+        """One sequence per row (no FFD packing) so row index == sequence
+        index and chosen/rejected interleaving survives."""
+        from areal_tpu.utils.data import RowPackedBatch
+
+        mask = batch["attention_mask"].astype(bool)
+        B, L = mask.shape
+        row_len = self._row_len(batch)
+        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        mult = n_mbs * dp * 2  # pairs must not straddle shard boundaries
+        R = ((B + mult - 1) // mult) * mult
+        lens = mask.sum(-1).astype(np.int32)
+        data = {}
+        for k, arr in batch.items():
+            if k == "attention_mask" or not (
+                arr.ndim >= 2 and arr.shape[:2] == (B, L)
+            ):
+                continue
+            buf = np.zeros((R, row_len, *arr.shape[2:]), arr.dtype)
+            buf[:B, :L] = arr * mask.reshape(B, L, *([1] * (arr.ndim - 2)))
+            data[k] = buf
+        seg = np.full((R, row_len), -1, np.int32)
+        pos = np.zeros((R, row_len), np.int32)
+        for i in range(B):
+            seg[i, : lens[i]] = 0
+            pos[i, : lens[i]] = np.arange(lens[i])
+        data["segment_ids"] = seg
+        data["positions"] = pos
+        data["input_ids"] = data["input_ids"].astype(np.int32)
+        placements = [[(i, int(lens[i]))] if i < B else [] for i in range(R)]
+        return (
+            RowPackedBatch(data=data, placements=placements, row_len=row_len),
+            data,
+            row_len,
+        )
+
+    def train_rw(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if batch["attention_mask"].shape[0] % 2 != 0:
+            raise ValueError("reward batches must interleave chosen/rejected pairs")
+        stats = self.train_batch(
+            batch,
+            _rw_loss,
+            loss_weight_fn=lambda b: float(
+                np.sum(np.any(b["segment_ids"] >= 0, axis=-1)) // 2 or 1
+            ),
+        )
+        n = max(stats.get("n_pairs", 1.0), 1.0)
+        stats["acc"] = stats.get("acc", 0.0) / n
+        return stats
+
+    def evaluate_rw(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        stats = self.eval_batch(
+            batch,
+            _rw_loss,
+            loss_weight_fn=lambda b: float(
+                np.sum(np.any(b["segment_ids"] >= 0, axis=-1)) // 2 or 1
+            ),
+        )
+        n = max(stats.get("n_pairs", 1.0), 1.0)
+        stats["acc"] = stats.get("acc", 0.0) / n
+        return stats

@@ -1,0 +1,3 @@
+from areal_tpu.engine.sft.lm_engine import JaxLMEngine
+
+__all__ = ["JaxLMEngine"]

@@ -1,0 +1,45 @@
+"""Supervised finetuning engine.
+
+Behavioral counterpart of the reference's `LMEngine`/`FSDPLMEngine`
+(areal/engine/sft/lm_engine.py): token cross-entropy over completion tokens,
+globally normalised by valid-token count.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.ops.functional import sft_loss_fn
+
+
+def _weight(batch: Dict[str, np.ndarray]) -> float:
+    return float(np.sum(batch["loss_mask"]))
+
+
+class JaxLMEngine(JaxTrainEngine):
+    """Trajectory convention: `loss_mask[t] = 1` iff token t is a completion
+    token; the engine shifts it to predictor alignment internally."""
+
+    @staticmethod
+    def _predictor_align(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = dict(batch)
+        mask = np.roll(batch["loss_mask"].astype(np.float32), -1, axis=-1)
+        mask[:, -1] = 0.0
+        out["loss_mask"] = mask * batch["attention_mask"]
+        return out
+
+    def train_lm(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = self._predictor_align(batch)
+        stats = self.train_batch(batch, sft_loss_fn, _weight)
+        n = max(stats.get("n_valid_tokens", 1.0), 1.0)
+        stats["ppl"] = float(np.exp(min(stats["loss_sum"] / n, 30.0)))
+        stats["token_acc"] = stats.get("correct_tokens", 0.0) / n
+        return stats
+
+    def evaluate_lm(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        batch = self._predictor_align(batch)
+        stats = self.eval_batch(batch, sft_loss_fn, _weight)
+        n = max(stats.get("n_valid_tokens", 1.0), 1.0)
+        stats["ppl"] = float(np.exp(min(stats["loss_sum"] / n, 30.0)))
+        return stats

@@ -176,15 +176,15 @@ def _layer_forward(
     return x, new_cache
 
 
-def forward(
+def forward_hidden(
     params: Params,
     cfg: TransformerConfig,
     input_ids: jax.Array,  # int32 [B, T]
     positions: jax.Array,  # int32 [B, T]
     segment_ids: jax.Array,  # int32 [B, T], -1 = padding
 ) -> jax.Array:
-    """Full forward -> logits [B, T, V] (in cfg.dtype; softmax-sensitive
-    consumers should upcast)."""
+    """Backbone forward -> final-norm hidden states [B, T, D] (for value /
+    reward heads, the role of the reference's critic models)."""
     dtype = jnp.dtype(cfg.dtype)
     x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
@@ -199,12 +199,24 @@ def forward(
         return x, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def forward(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,  # int32 [B, T]
+    positions: jax.Array,  # int32 [B, T]
+    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+) -> jax.Array:
+    """Full forward -> logits [B, T, V] (in cfg.dtype; softmax-sensitive
+    consumers should upcast)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = forward_hidden(params, cfg, input_ids, positions, segment_ids)
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
-    logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype))
-    return logits
+    return jnp.einsum("btd,dv->btv", x, head.astype(dtype))
 
 
 def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax.Array]):

@@ -160,6 +160,7 @@ def grpo_loss_fn(
     temperature: float = 1.0,
     use_decoupled_loss: bool = True,
     entropy_coef: float = 0.0,
+    eps_clip_higher: Optional[float] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Packed GRPO/PPO policy loss over next-token logits
     (reference: areal/engine/ppo/actor.py:313-391 grpo_loss_fn).
@@ -182,6 +183,7 @@ def grpo_loss_fn(
         c_clip=c_clip,
         proximal_logprobs=prox,
         behav_imp_weight_cap=behav_imp_weight_cap,
+        eps_clip_higher=eps_clip_higher,
     )
     if entropy_coef:
         loss = loss - entropy_coef * jnp.sum(entropy * loss_mask)
@@ -238,13 +240,22 @@ def sft_loss_fn(
 
 
 def pairwise_reward_loss_fn(
-    chosen_scores: jax.Array, rejected_scores: jax.Array
+    chosen_scores: jax.Array,
+    rejected_scores: jax.Array,
+    pair_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Bradley-Terry pairwise loss (reference: areal/engine/rw/rw_engine.py)."""
+    """Bradley-Terry pairwise loss (reference: areal/engine/rw/rw_engine.py).
+    `pair_mask` excludes filler pairs (dp-padding rows)."""
     margin = chosen_scores - rejected_scores
-    loss = -jnp.sum(jax.nn.log_sigmoid(margin))
-    acc = jnp.sum(margin > 0)
-    return loss, {"acc": acc, "margin": jnp.sum(margin), "n_pairs": jnp.asarray(margin.size, jnp.float32)}
+    if pair_mask is None:
+        pair_mask = jnp.ones_like(margin)
+    pair_mask = pair_mask.astype(jnp.float32)
+    loss = -jnp.sum(jax.nn.log_sigmoid(margin) * pair_mask)
+    return loss, {
+        "acc": jnp.sum((margin > 0) * pair_mask),
+        "margin": jnp.sum(margin * pair_mask),
+        "n_pairs": jnp.sum(pair_mask),
+    }
 
 
 def dpo_loss_fn(

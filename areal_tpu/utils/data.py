@@ -338,7 +338,12 @@ class Normalization:
             gm, _ = self._masked_moments(
                 group_view(x), group_view(mask), axis=tuple(range(1, x.ndim + 1))
             )
-            mean = np.repeat(gm, self.group_size, axis=0).reshape(x.shape)
+            # gm is [G, 1, ..., 1]; expand back to per-row then broadcast
+            mean = np.broadcast_to(
+                np.repeat(gm.reshape(-1), self.group_size)
+                .reshape(B, *([1] * (x.ndim - 1))),
+                x.shape,
+            )
         else:
             mean = np.zeros_like(x)
         centered = x - mean
@@ -348,7 +353,11 @@ class Normalization:
             _, gs = self._masked_moments(
                 group_view(x), group_view(mask), axis=tuple(range(1, x.ndim + 1))
             )
-            std = np.repeat(gs, self.group_size, axis=0).reshape(x.shape)
+            std = np.broadcast_to(
+                np.repeat(gs.reshape(-1), self.group_size)
+                .reshape(B, *([1] * (x.ndim - 1))),
+                x.shape,
+            )
         else:
             std = None
         denom = 1.0 if std is None else std + self.eps
