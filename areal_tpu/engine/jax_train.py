@@ -152,6 +152,9 @@ class JaxTrainEngine(TrainEngine):
     def _build_optimizer(self, ft_spec: Optional[FinetuneSpec]) -> None:
         oc = self.config.optimizer
         total_steps = ft_spec.total_train_steps if ft_spec is not None else 1_000_000
+        # the schedule is indexed per optimizer update, and PPO-style engines
+        # make ppo_n_minibatches updates per dataset iteration
+        total_steps *= max(1, getattr(self.config, "ppo_n_minibatches", 1))
         warmup = int(oc.warmup_steps_proportion * total_steps)
         peak, floor = oc.lr, oc.lr * oc.min_lr_ratio
         if oc.lr_scheduler_type == "cosine":
@@ -272,15 +275,18 @@ class JaxTrainEngine(TrainEngine):
                 return loss / total_weight, stats
 
             grad_fn = jax.value_and_grad(mb_loss, has_aux=True)
+            # accumulate at master-weight precision: fp32 masters get fp32
+            # accumulation (reference behavior); bf16-master (memory-tight)
+            # runs avoid doubling gradient HBM
             zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
+                lambda p: jnp.zeros(p.shape, p.dtype), params
             )
 
             def scan_body(carry, mb):
                 grads_acc, loss_acc = carry
                 (loss, stats), grads = grad_fn(params, mb)
                 grads_acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads
                 )
                 return (grads_acc, loss_acc + loss), stats
 

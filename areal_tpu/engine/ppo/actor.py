@@ -142,9 +142,13 @@ class PPOActor:
         tok_rewards[np.arange(B), idx] += np.where(has_completion, rewards, 0.0)
 
         # ---- GAE (values default 0: GRPO / reward-to-go)
+        # values are NOT rolled: the critic head's output at position t is
+        # V(prefix through token t) = the state before emitting token t+1,
+        # which is already predictor alignment — rolling would train the
+        # critic one step shifted
         values = batch.get("values")
         values = (
-            _roll_back(values.astype(np.float32)) * mask
+            values.astype(np.float32) * mask
             if values is not None
             else np.zeros((B, L), np.float32)
         )

@@ -49,6 +49,11 @@ class JaxRewardModelEngine(JaxPPOCritic):
         mult = n_mbs * dp * 2  # pairs must not straddle shard boundaries
         R = ((B + mult - 1) // mult) * mult
         lens = mask.sum(-1).astype(np.int32)
+        if lens.max(initial=0) > row_len:
+            raise ValueError(
+                f"sequence of length {int(lens.max())} exceeds max_pack_length "
+                f"bucket {row_len}"
+            )
         data = {}
         for k, arr in batch.items():
             if k == "attention_mask" or not (
@@ -56,7 +61,8 @@ class JaxRewardModelEngine(JaxPPOCritic):
             ):
                 continue
             buf = np.zeros((R, row_len, *arr.shape[2:]), arr.dtype)
-            buf[:B, :L] = arr * mask.reshape(B, L, *([1] * (arr.ndim - 2)))
+            for i in range(B):  # per-sequence copy: L may exceed row_len
+                buf[i, : lens[i]] = arr[i, : lens[i]]
             data[k] = buf
         seg = np.full((R, row_len), -1, np.int32)
         pos = np.zeros((R, row_len), np.int32)

@@ -202,3 +202,44 @@ def test_reward_model_separates_pairs():
     }
     accs = [rw.train_rw(batch)["acc"] for _ in range(25)]
     assert accs[-1] == 1.0, accs
+
+
+def test_critic_values_not_shifted_by_advantage_pipeline():
+    """values go through compute_advantages un-rolled: the value head output
+    at position t is already V(state before token t+1)."""
+    actor = _actor(group_size=1, adv_norm=None, kl_ctl=0.0)
+    B, L = 1, 6
+    values = np.array([[0.1, 0.2, 0.3, 0.4, 0.5, 0.6]], np.float32)
+    batch = {
+        "input_ids": np.arange(L, dtype=np.int32)[None],
+        "attention_mask": np.ones((B, L), bool),
+        "loss_mask": np.array([[0, 0, 1, 1, 1, 1]], np.float32),
+        "logprobs": np.zeros((B, L), np.float32),
+        "rewards": np.array([1.0], np.float32),
+        "versions": np.zeros((B, L), np.int32),
+        "values": values.copy(),
+    }
+    actor.compute_advantages(batch)
+    mask = batch["loss_mask"]  # predictor-aligned: positions 1..4
+    np.testing.assert_array_equal(mask[0], [0, 1, 1, 1, 1, 0])
+    # gamma=lam=1: returns[t] = sum future rewards = 1 at all masked t,
+    # advantages = returns - values at the SAME (unshifted) positions
+    np.testing.assert_allclose(
+        batch["advantages"][0][1:5], 1.0 - values[0][1:5], atol=1e-5
+    )
+    np.testing.assert_allclose(batch["returns"][0][1:5], 1.0, atol=1e-5)
+
+
+def test_reward_model_handles_wide_padding():
+    """Batch padded far wider than its longest sequence must not crash row
+    preparation (padded width > bucketed row_len)."""
+    rng = np.random.default_rng(7)
+    cfg = PPOCriticConfig(**_base_kwargs(lr=1e-2))
+    rw = JaxRewardModelEngine(cfg, model_config=MODEL_CFG)
+    rw.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    B, L = 4, 64  # quantum=16 -> row_len 16 < L
+    ids = rng.integers(3, 64, (B, L)).astype(np.int32)
+    mask = np.zeros((B, L), bool)
+    mask[:, :10] = True
+    stats = rw.train_rw({"input_ids": ids, "attention_mask": mask})
+    assert np.isfinite(stats["loss"])
