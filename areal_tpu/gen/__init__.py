@@ -1,0 +1,3 @@
+from areal_tpu.gen.engine import GenEngine, GenRequest
+
+__all__ = ["GenEngine", "GenRequest"]

@@ -1,0 +1,312 @@
+"""Continuous-batching generation engine on a fixed slot grid.
+
+The TPU-native replacement for the SGLang/vLLM servers the reference wraps
+(areal/launcher/sglang_server.py:117, realhf generation servers) and for the
+legacy native decode loop (realhf/impl/model/nn/real_llm_generate.py).
+Design for XLA's static shapes:
+
+- `n_slots` concurrent sequences in a preallocated KV cache
+  [L, S, M, Hkv, hd]; admission assigns a free slot, completion frees it —
+  continuous batching without shape changes.
+- TWO compiled programs: `forward_prefill` per prompt bucket (power-of-two
+  padded) and ONE `forward_decode` step advancing every slot; idle slots
+  decode garbage that is never read (cheaper than recompiling for occupancy).
+- Cache and rng are donated; steady-state decode allocates nothing.
+- Weight reload (`load_weights`) aborts in-flight requests with
+  stop_reason="abort" — the client's interruption loop resubmits with
+  accumulated tokens (reference behavior: remote_inf_engine.py:428-478) —
+  then bumps `version`; per-token versions let decoupled PPO weight stale
+  spans correctly.
+"""
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.gen.sampling import sample_tokens
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.models.transformer import (
+    forward_decode,
+    forward_prefill,
+    init_kv_cache,
+    init_params,
+)
+from areal_tpu.models.hf import load_hf_params
+from areal_tpu.utils import logging
+from areal_tpu.utils.datapack import round_up_to_bucket
+
+logger = logging.getLogger("gen.engine")
+
+
+@dataclass
+class GenRequest:
+    rid: str
+    input_ids: List[int]
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    stop_token_ids: List[int] = field(default_factory=list)
+    # filled by the engine
+    output_tokens: List[int] = field(default_factory=list)
+    output_logprobs: List[float] = field(default_factory=list)
+    output_versions: List[int] = field(default_factory=list)
+    stop_reason: str = ""
+    on_done: Optional[Callable[["GenRequest"], None]] = None
+
+    def finish(self, reason: str):
+        self.stop_reason = reason
+        if self.on_done is not None:
+            self.on_done(self)
+
+
+class GenEngine:
+    def __init__(
+        self,
+        model_config: TransformerConfig,
+        params=None,
+        model_path: Optional[str] = None,
+        n_slots: int = 8,
+        max_seq_len: int = 2048,
+        prompt_bucket: int = 128,
+        kv_dtype: str = "bfloat16",
+        seed: int = 0,
+        decode_chunk: int = 8,
+    ):
+        self.model_config = model_config.replace(remat=False)
+        if params is None:
+            if model_path:
+                host, mc = load_hf_params(model_path, model_config, dtype="bfloat16")
+                self.model_config = mc.replace(
+                    dtype=model_config.dtype, param_dtype="bfloat16", remat=False
+                )
+                params = host
+            else:
+                params = init_params(self.model_config, jax.random.PRNGKey(seed))
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.n_slots = n_slots
+        self.max_seq_len = max_seq_len
+        self.prompt_bucket = prompt_bucket
+        self.cache = init_kv_cache(self.model_config, n_slots, max_seq_len, kv_dtype)
+        self.rng = jax.random.PRNGKey(seed)
+        self.version = 0
+
+        # host-side slot state
+        self.slot_req: List[Optional[GenRequest]] = [None] * n_slots
+        self.lengths = np.zeros(n_slots, np.int32)
+        self.last_tokens = np.zeros(n_slots, np.int32)
+        self.temperature = np.ones(n_slots, np.float32)
+        self.top_p = np.ones(n_slots, np.float32)
+        self.top_k = np.zeros(n_slots, np.int32)
+        self.pending: "queue.Queue[GenRequest]" = queue.Queue()
+        self._lock = threading.Lock()
+
+        # decode_chunk: tokens generated per host round-trip.  The decode scan
+        # runs this many fused forward+sample steps on device before the host
+        # sees anything — the host applies stop conditions in arrears and
+        # discards overshoot (slots that stopped mid-chunk decode garbage that
+        # is never delivered).  Chunking amortises host<->device latency,
+        # which dominates when the chip is reached over a network tunnel.
+        self.decode_chunk = max(1, decode_chunk)
+        cfg = self.model_config
+
+        def _prefill(params, cache, ids, plen, slot, rng, temp, tp, tk):
+            logits, cache = forward_prefill(params, cfg, ids, plen, cache, slot)
+            tok, logp = sample_tokens(logits, rng, temp, tk, tp)
+            return tok, logp, cache
+
+        def _decode_chunk(params, cache, tokens, lengths, rng, temp, tp, tk, n):
+            def body(carry, _):
+                cache, tokens, lengths, rng = carry
+                logits, cache = forward_decode(params, cfg, tokens, lengths, cache)
+                rng, sub = jax.random.split(rng)
+                tok, logp = sample_tokens(
+                    logits.astype(jnp.float32), sub, temp, tk, tp
+                )
+                return (cache, tok, lengths + 1, rng), (tok, logp)
+
+            (cache, _, _, _), (toks, logps) = jax.lax.scan(
+                body, (cache, tokens, lengths, rng), None, length=n
+            )
+            # one fused download: tokens are exactly representable in f32
+            out = jnp.stack([toks.astype(jnp.float32), logps])  # [2, n, S]
+            return out, cache
+
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
+        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(8,),
+                                  donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # submission / weights
+    # ------------------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> None:
+        if len(req.input_ids) + 1 >= self.max_seq_len:
+            req.finish("length")
+            return
+        self.pending.put(req)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return sum(r is not None for r in self.slot_req) + self.pending.qsize()
+
+    def abort_all(self, reason: str = "abort") -> int:
+        """Finish every in-flight request immediately (weight update /
+        shutdown). Returns how many were aborted."""
+        n = 0
+        with self._lock:
+            for s, req in enumerate(self.slot_req):
+                if req is not None:
+                    req.finish(reason)
+                    self.slot_req[s] = None
+                    n += 1
+            while True:
+                try:
+                    self.pending.get_nowait().finish(reason)
+                    n += 1
+                except queue.Empty:
+                    break
+        return n
+
+    def load_weights(
+        self, path: Optional[str] = None, params=None, version: Optional[int] = None
+    ) -> int:
+        """Swap weights; aborts in-flight generation first (interruptible
+        generation: clients resubmit and the new prefill recomputes under the
+        new policy). Returns the new version."""
+        aborted = self.abort_all("abort")
+        if aborted:
+            logger.info(f"aborted {aborted} requests for weight update")
+        if params is None:
+            assert path is not None
+            params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.version = version if version is not None else self.version + 1
+        return self.version
+
+    # ------------------------------------------------------------------
+    # stepping
+    # ------------------------------------------------------------------
+
+    def _admit(self) -> None:
+        for s in range(self.n_slots):
+            if self.slot_req[s] is not None:
+                continue
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                return
+            plen = len(req.input_ids)
+            bucket = round_up_to_bucket(
+                max(plen, 1), self.prompt_bucket, self.max_seq_len
+            )
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :plen] = req.input_ids
+            self.rng, sub = jax.random.split(self.rng)
+            tok, logp, self.cache = self._prefill_fn(
+                self.params,
+                self.cache,
+                ids,
+                jnp.asarray([plen], jnp.int32),
+                s,
+                sub,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_p], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+            )
+            tok, logp = int(tok[0]), float(logp[0])
+            with self._lock:
+                self.slot_req[s] = req
+                self.lengths[s] = plen
+                self.last_tokens[s] = tok
+                self.temperature[s] = req.temperature
+                self.top_p[s] = req.top_p
+                self.top_k[s] = req.top_k
+            self._record_token(s, tok, logp)
+
+    def _record_token(self, s: int, tok: int, logp: float) -> None:
+        req = self.slot_req[s]
+        if req is None:  # aborted between decode and delivery
+            return
+        req.output_tokens.append(tok)
+        req.output_logprobs.append(logp)
+        req.output_versions.append(self.version)
+        n_out = len(req.output_tokens)
+        stop_ids = req.stop_token_ids or (
+            [self.model_config.eos_token_id]
+            if self.model_config.eos_token_id is not None
+            else []
+        )
+        hit_stop = tok in stop_ids and n_out >= req.min_new_tokens
+        total_len = self.lengths[s] + 1  # prompt + generated so far
+        if hit_stop:
+            self._free(s, "stop")
+        elif n_out >= req.max_new_tokens or total_len + 1 >= self.max_seq_len:
+            self._free(s, "length")
+
+    def _free(self, s: int, reason: str) -> None:
+        req = self.slot_req[s]
+        with self._lock:
+            self.slot_req[s] = None
+        if req is not None:
+            req.finish(reason)
+
+    def step(self, chunk: Optional[int] = None) -> int:
+        """Admit pending prompts, then advance every active slot by up to
+        `chunk` tokens in one device program.  Returns generated-token count
+        actually delivered (overshoot past stop conditions excluded)."""
+        self._admit()
+        with self._lock:
+            active = [s for s in range(self.n_slots) if self.slot_req[s] is not None]
+        if not active:
+            return 0
+        n = chunk or self.decode_chunk
+        # never decode past the cache: bound by the tightest active slot.
+        # n is a static jit arg, so round the clamp DOWN to a power of two —
+        # O(log decode_chunk) compiled programs instead of one per length.
+        cap = max(1, int(self.max_seq_len - 1 - self.lengths[active].max()))
+        n = min(n, cap)
+        if n < (chunk or self.decode_chunk):
+            n = 1 << (n.bit_length() - 1)
+        self.rng, sub = jax.random.split(self.rng)
+        out, self.cache = self._decode_fn(
+            self.params,
+            self.cache,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.lengths),
+            sub,
+            jnp.asarray(self.temperature),
+            jnp.asarray(self.top_p),
+            jnp.asarray(self.top_k),
+            n,
+        )
+        out = np.asarray(out)  # [2, n, S]
+        toks = out[0].astype(np.int32)
+        logps = out[1]
+        delivered = 0
+        for s in active:
+            for i in range(n):
+                if self.slot_req[s] is None:
+                    break  # stopped mid-chunk; remaining tokens are overshoot
+                self.lengths[s] += 1  # K/V for this token is in the cache
+                self.last_tokens[s] = toks[i, s]
+                self._record_token(s, int(toks[i, s]), float(logps[i, s]))
+                delivered += 1
+        return delivered
+
+    def generate_blocking(self, reqs: List[GenRequest]) -> List[GenRequest]:
+        """Synchronous helper (tests / offline eval): run until all done."""
+        for r in reqs:
+            self.submit(r)
+        while any(not r.stop_reason for r in reqs):
+            if self.step() == 0 and self.pending.qsize() == 0:
+                break
+            time.sleep(0)
+        return reqs

@@ -1,0 +1,56 @@
+"""Batched token sampling, shape-static for the decode jit.
+
+Counterpart of the sampling the reference delegates to SGLang/vLLM servers
+(temperature / top-k / top-p / greedy, areal/api/cli_args.py
+GenerationHyperparameters).  Per-slot parameters are arrays so one compiled
+step serves heterogeneous requests; top-k/top-p run inside a static
+`TOPK_WINDOW`-wide candidate window (lax.top_k), which is exact whenever the
+nucleus fits the window — 64 candidates at temperature ≤ 1 covers it in
+practice.  Returned logprobs are exact full-vocab log-softmax values.
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+TOPK_WINDOW = 64
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,  # [S, V] fp32
+    rng: jax.Array,
+    temperature: jax.Array,  # [S]; 0 = greedy
+    top_k: jax.Array,  # [S] int32; 0 = disabled
+    top_p: jax.Array,  # [S]; 1.0 = disabled
+):
+    """Returns (tokens [S], logprobs [S]) — logprob of the sampled token
+    under the *unmodified* (temperature-scaled) distribution, matching what
+    inference servers report and what decoupled PPO consumes."""
+    S, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = temperature <= 0.0
+    safe_temp = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / safe_temp[:, None]
+
+    # candidate window
+    win_logits, win_idx = jax.lax.top_k(scaled, TOPK_WINDOW)  # [S, W]
+    ranks = jnp.arange(TOPK_WINDOW)[None, :]
+    # top-k mask (0 = off)
+    k = jnp.where(top_k <= 0, TOPK_WINDOW, jnp.minimum(top_k, TOPK_WINDOW))
+    keep = ranks < k[:, None]
+    # top-p mask over the window distribution
+    win_probs = jax.nn.softmax(win_logits, axis=-1)
+    cum = jnp.cumsum(win_probs, axis=-1)
+    keep &= (cum - win_probs) < top_p[:, None]  # keep first token exceeding p
+    keep |= ranks == 0  # top_p=0 must mean near-greedy, never mask everything
+    masked = jnp.where(keep, win_logits, NEG_INF)
+
+    choice = jax.random.categorical(rng, masked, axis=-1)  # [S] window index
+    sampled = jnp.take_along_axis(win_idx, choice[:, None], axis=-1)[:, 0]
+    tokens = jnp.where(greedy, win_idx[:, 0], sampled)
+
+    logz = jax.nn.logsumexp(scaled, axis=-1)
+    tok_logit = jnp.take_along_axis(scaled, tokens[:, None], axis=-1)[:, 0]
+    return tokens, tok_logit - logz

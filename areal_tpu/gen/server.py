@@ -1,0 +1,268 @@
+"""HTTP generation server.
+
+Serves the wire protocol the client backend speaks
+(areal_tpu/engine/jax_remote.py) — the role SGLang's HTTP server plays for
+the reference (areal/engine/sglang_remote.py:22 builds /generate,
+/update_weights_from_disk, /pause_generation against it):
+
+    POST /generate                 {rid, input_ids, sampling_params} ->
+                                   {output_tokens, output_logprobs,
+                                    output_versions, stop_reason, version}
+    POST /pause_generation         decode loop parks (weight-update window)
+    POST /continue_generation
+    POST /update_weights_from_disk {path, version?}
+    POST /update_weights_chunk     {name, dtype, shape, data_b64, commit?}
+    GET  /health, /metrics
+
+A dedicated worker thread owns all device computation (admission, decode
+steps, weight swaps) so the asyncio loop never blocks on XLA; handlers talk
+to it through the engine's queues and concurrent futures.  Registration in
+name_resolve mirrors the reference's server wrappers
+(areal/launcher/sglang_server.py registers its address for discovery).
+"""
+
+import argparse
+import asyncio
+import base64
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from aiohttp import web
+
+from areal_tpu.gen.engine import GenEngine, GenRequest
+from areal_tpu.models.model_config import TransformerConfig, tiny_config
+from areal_tpu.utils import logging, name_resolve, names, network
+
+logger = logging.getLogger("gen.server")
+
+
+class GenServer:
+    def __init__(self, engine: GenEngine):
+        self.engine = engine
+        self.paused = threading.Event()  # set => paused
+        self.shutdown = threading.Event()
+        self._weight_futures: "list" = []
+        self._chunk_buf = {}
+        self._cmd_lock = threading.Lock()
+        self._pending_weight_update: Optional[dict] = None
+        self.worker = threading.Thread(target=self._run, daemon=True)
+        self.step_count = 0
+        self.tokens_out = 0
+        self.last_error: float = 0.0
+
+    # ------------------------------ worker ------------------------------
+
+    def start(self):
+        self.worker.start()
+
+    def _run(self):
+        while not self.shutdown.is_set():
+            upd = None
+            with self._cmd_lock:
+                if self._pending_weight_update is not None:
+                    upd = self._pending_weight_update
+                    self._pending_weight_update = None
+            if upd is not None:
+                try:
+                    v = self.engine.load_weights(
+                        path=upd.get("path"),
+                        params=upd.get("params"),
+                        version=upd.get("version"),
+                    )
+                    upd["future"].set_result(v)
+                except Exception as e:  # noqa: BLE001 — surface to the caller
+                    upd["future"].set_exception(e)
+                continue
+            if self.paused.is_set():
+                time.sleep(0.005)
+                continue
+            try:
+                stepped = self.engine.step()
+            except Exception:  # noqa: BLE001 — the loop must survive XLA errors
+                logger.exception("decode step failed; aborting in-flight requests")
+                self.last_error = time.time()
+                self.engine.abort_all("abort")
+                continue
+            self.step_count += 1 if stepped else 0
+            self.tokens_out += stepped
+            if not stepped:
+                time.sleep(0.002)
+
+    # ----------------------------- handlers -----------------------------
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        sp = body.get("sampling_params", {})
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def on_done(r: GenRequest):
+            loop.call_soon_threadsafe(fut.set_result, r)
+
+        req = GenRequest(
+            rid=body.get("rid", ""),
+            input_ids=[int(t) for t in body["input_ids"]],
+            max_new_tokens=int(sp.get("max_new_tokens", 256)),
+            min_new_tokens=int(sp.get("min_new_tokens", 0)),
+            temperature=float(sp.get("temperature", 1.0)),
+            top_p=float(sp.get("top_p", 1.0)),
+            top_k=int(sp.get("top_k", 0)),
+            stop_token_ids=[int(t) for t in sp.get("stop_token_ids", [])],
+            on_done=on_done,
+        )
+        self.engine.submit(req)
+        r: GenRequest = await fut
+        return web.json_response(
+            {
+                "output_tokens": r.output_tokens,
+                "output_logprobs": r.output_logprobs,
+                "output_versions": r.output_versions,
+                "stop_reason": r.stop_reason or "stop",
+                "version": self.engine.version,
+            }
+        )
+
+    async def pause(self, request: web.Request) -> web.Response:
+        self.paused.set()
+        return web.json_response({"ok": True})
+
+    async def resume(self, request: web.Request) -> web.Response:
+        self.paused.clear()
+        return web.json_response({"ok": True})
+
+    def _queue_weight_update(self, **kw):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        with self._cmd_lock:
+            self._pending_weight_update = {"future": fut, **kw}
+        return fut
+
+    async def update_weights_from_disk(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        fut = self._queue_weight_update(
+            path=body["path"], version=body.get("version")
+        )
+        version = await asyncio.wrap_future(fut)
+        return web.json_response({"ok": True, "version": version})
+
+    async def update_weights_chunk(self, request: web.Request) -> web.Response:
+        """Transfer path: the trainer streams named arrays; `commit` swaps
+        them in (counterpart of the reference's NCCL broadcast bucket
+        protocol, fsdp_engine.py:298-330, over HTTP/DCN instead)."""
+        body = await request.json()
+        if body.get("commit"):
+            from areal_tpu.models.hf import state_to_params
+
+            host = self._chunk_buf
+            self._chunk_buf = {}
+            params = state_to_params(
+                iter(host.items()), self.engine.model_config, dtype="bfloat16"
+            )
+            fut = self._queue_weight_update(
+                params=params, version=body.get("version")
+            )
+            version = await asyncio.wrap_future(fut)
+            return web.json_response({"ok": True, "version": version})
+        arr = np.frombuffer(
+            base64.b64decode(body["data_b64"]), dtype=np.dtype(body["dtype"])
+        ).reshape(body["shape"])
+        self._chunk_buf[body["name"]] = arr
+        return web.json_response({"ok": True, "received": body["name"]})
+
+    async def health(self, request: web.Request) -> web.Response:
+        if not self.worker.is_alive() and not self.shutdown.is_set():
+            return web.json_response({"status": "dead"}, status=500)
+        return web.json_response(
+            {
+                "status": "paused" if self.paused.is_set() else "ok",
+                "version": self.engine.version,
+                "active": self.engine.active_count(),
+                "last_error": self.last_error,
+            }
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "decode_steps": self.step_count,
+                "tokens_generated": self.tokens_out,
+                "active": self.engine.active_count(),
+                "version": self.engine.version,
+            }
+        )
+
+    # ------------------------------ wiring ------------------------------
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_post("/generate", self.generate)
+        app.router.add_post("/pause_generation", self.pause)
+        app.router.add_post("/continue_generation", self.resume)
+        app.router.add_post("/update_weights_from_disk", self.update_weights_from_disk)
+        app.router.add_post("/update_weights_chunk", self.update_weights_chunk)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        return app
+
+
+
+
+def serve(
+    engine: GenEngine,
+    host: str = "0.0.0.0",
+    port: Optional[int] = None,
+    experiment_name: str = "",
+    trial_name: str = "",
+    server_idx: int = 0,
+):
+    """Blocking serve; registers the address in name_resolve for discovery
+    (reference: sglang_server.py registration)."""
+    port = port or network.find_free_port()
+    server = GenServer(engine)
+    server.start()
+    if experiment_name:
+        name_resolve.add(
+            names.gen_server(experiment_name, trial_name, str(server_idx)),
+            f"{network.gethostip()}:{port}",
+            replace=True,
+        )
+    logger.info(f"generation server on {host}:{port}")
+    web.run_app(server.app(), host=host, port=port, print=None)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-path", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--n-slots", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--server-idx", type=int, default=0)
+    args = p.parse_args()
+    if args.model_path:
+        cfg = TransformerConfig.from_hf(args.model_path)
+        engine = GenEngine(
+            cfg.replace(dtype="bfloat16"),
+            model_path=args.model_path,
+            n_slots=args.n_slots,
+            max_seq_len=args.max_seq_len,
+        )
+    else:
+        engine = GenEngine(tiny_config(), n_slots=args.n_slots,
+                           max_seq_len=args.max_seq_len)
+    serve(
+        engine,
+        port=args.port or None,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        server_idx=args.server_idx,
+    )
+
+
+if __name__ == "__main__":
+    main()

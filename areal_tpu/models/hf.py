@@ -78,17 +78,18 @@ def iter_safetensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
                 yield name, sf.get_tensor(name)
 
 
-def load_hf_params(
-    path: str,
-    cfg: Optional[TransformerConfig] = None,
+def state_to_params(
+    items: Iterator[Tuple[str, np.ndarray]],
+    cfg: TransformerConfig,
     dtype: str = "float32",
-) -> Tuple[Dict[str, Any], TransformerConfig]:
-    """Load an HF checkpoint dir into the scan-stacked param pytree."""
-    if cfg is None:
-        cfg = TransformerConfig.from_hf(path)
+) -> Dict[str, Any]:
+    """HF-named (name, array) pairs -> scan-stacked param pytree, with
+    completeness validation.  Shared by checkpoint loading and the
+    streamed weight-update path (gen/server.py /update_weights_chunk)."""
     L = cfg.num_layers
     np_dtype = np.dtype(dtype)
     params: Dict[str, Any] = {"layers": {}}
+    fill_count: Dict[Tuple[str, ...], int] = {}
 
     def layer_buf(path_in_layer: Tuple[str, ...], shape):
         try:
@@ -99,7 +100,7 @@ def load_hf_params(
             return buf
 
     seen_head = False
-    for name, arr in iter_safetensors(path):
+    for name, arr in items:
         arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; astype below handles it
         m = _LAYER_RE.match(name)
         if m:
@@ -112,6 +113,7 @@ def load_hf_params(
                 arr = arr.T
             buf = layer_buf(path_in_layer, arr.shape)
             buf[idx] = arr.astype(np_dtype)
+            fill_count[path_in_layer] = fill_count.get(path_in_layer, 0) + 1
         elif name == "model.embed_tokens.weight":
             params["embedding"] = arr.astype(np_dtype)
         elif name == "model.norm.weight":
@@ -121,11 +123,31 @@ def load_hf_params(
             seen_head = True
         else:
             logger.warning("skipping unmapped weight %s", name)
+    for path_in_layer, n in fill_count.items():
+        if n != L:
+            raise ValueError(
+                f"incomplete weights: {'.'.join(path_in_layer)} filled for "
+                f"{n}/{L} layers"
+            )
+    for required in ("embedding", "final_norm"):
+        if required not in params:
+            raise ValueError(f"checkpoint missing {required}")
     if cfg.tie_word_embeddings and seen_head:
         del params["lm_head"]
     if not cfg.tie_word_embeddings and not seen_head:
         raise ValueError("untied config but checkpoint has no lm_head.weight")
-    return params, cfg
+    return params
+
+
+def load_hf_params(
+    path: str,
+    cfg: Optional[TransformerConfig] = None,
+    dtype: str = "float32",
+) -> Tuple[Dict[str, Any], TransformerConfig]:
+    """Load an HF checkpoint dir into the scan-stacked param pytree."""
+    if cfg is None:
+        cfg = TransformerConfig.from_hf(path)
+    return state_to_params(iter_safetensors(path), cfg, dtype), cfg
 
 
 def params_to_hf_state(

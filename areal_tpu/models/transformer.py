@@ -124,56 +124,20 @@ def _layer_forward(
     cos: jax.Array,
     sin: jax.Array,
     mask: jax.Array,
-    kv_cache: Optional[Dict[str, jax.Array]] = None,
-    cache_index: Optional[jax.Array] = None,
 ):
-    """One decoder block. If kv_cache is given (decode), keys/values are
-    written at `cache_index` and attention runs over the cache."""
-    B, T, D = x.shape
-    hd = cfg.head_dim_
+    """One decoder block (cache-free; the generation paths below thread
+    their own cache through the same _qkv/_mlp primitives)."""
+    B, T, _ = x.shape
     dtype = x.dtype
-
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-    q = jnp.einsum("btd,dh->bth", h, lp["attn"]["wq"].astype(dtype))
-    k = jnp.einsum("btd,dh->bth", h, lp["attn"]["wk"].astype(dtype))
-    v = jnp.einsum("btd,dh->bth", h, lp["attn"]["wv"].astype(dtype))
-    if cfg.qkv_bias:
-        q = q + lp["attn"]["bq"].astype(dtype)
-        k = k + lp["attn"]["bk"].astype(dtype)
-        v = v + lp["attn"]["bv"].astype(dtype)
-    q = q.reshape(B, T, cfg.num_heads, hd)
-    k = k.reshape(B, T, cfg.num_kv_heads, hd)
-    v = v.reshape(B, T, cfg.num_kv_heads, hd)
-    if cfg.qk_norm:
-        q = rms_norm(q, lp["attn"]["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, lp["attn"]["k_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, lp, h, dtype)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-
-    new_cache = None
-    if kv_cache is not None:
-        ck = jax.lax.dynamic_update_slice(
-            kv_cache["k"], k.astype(kv_cache["k"].dtype), (0, cache_index, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            kv_cache["v"], v.astype(kv_cache["v"].dtype), (0, cache_index, 0, 0)
-        )
-        new_cache = {"k": ck, "v": cv}
-        k, v = ck.astype(dtype), cv.astype(dtype)
-
     attn_out = attention(q, k, v, mask, cfg.attn_logit_softcap)
     attn_out = attn_out.reshape(B, T, cfg.q_size)
-    attn_out = jnp.einsum("bth,hd->btd", attn_out, lp["attn"]["wo"].astype(dtype))
-    x = x + attn_out
-
+    x = x + jnp.einsum("bth,hd->btd", attn_out, lp["attn"]["wo"].astype(dtype))
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-    gate = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_gate"].astype(dtype))
-    up = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_up"].astype(dtype))
-    down = jnp.einsum(
-        "btf,fd->btd", jax.nn.silu(gate) * up, lp["mlp"]["w_down"].astype(dtype)
-    )
-    x = x + down
-    return x, new_cache
+    return x + _mlp(lp, h, dtype), None
 
 
 def forward_hidden(
@@ -225,6 +189,159 @@ def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax
     pos = packed["positions"][None, :]
     seg = packed["segment_ids"][None, :]
     return forward(params, cfg, ids, pos, seg)[0]
+
+
+# ---------------------------------------------------------------------------
+# KV-cache forward paths (generation engine)
+# ---------------------------------------------------------------------------
+#
+# The decode-time counterpart of the reference's native generation runtime
+# (realhf/impl/model/nn/real_llm_generate.py KV-cache decode loop) and of the
+# SGLang servers it normally delegates to.  Cache layout is layer-stacked to
+# match the scan parameter layout:
+#     k, v: [L, S, M, Hkv, hd]   (S = batch slots, M = max seq len)
+# Both entry points are shape-static: prefill takes a padded prompt bucket,
+# decode advances every slot by exactly one token.
+
+
+def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
+    q = jnp.einsum("btd,dh->bth", h, lp["attn"]["wq"].astype(dtype))
+    k = jnp.einsum("btd,dh->bth", h, lp["attn"]["wk"].astype(dtype))
+    v = jnp.einsum("btd,dh->bth", h, lp["attn"]["wv"].astype(dtype))
+    if cfg.qkv_bias:
+        q = q + lp["attn"]["bq"].astype(dtype)
+        k = k + lp["attn"]["bk"].astype(dtype)
+        v = v + lp["attn"]["bv"].astype(dtype)
+    B, T = h.shape[:2]
+    q = q.reshape(B, T, cfg.num_heads, cfg.head_dim_)
+    k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
+    v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["attn"]["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["attn"]["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
+
+
+def _mlp(lp: Params, h: jax.Array, dtype):
+    gate = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_gate"].astype(dtype))
+    up = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_up"].astype(dtype))
+    return jnp.einsum(
+        "btf,fd->btd", jax.nn.silu(gate) * up, lp["mlp"]["w_down"].astype(dtype)
+    )
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, n_slots: int, max_len: int, dtype: str = "bfloat16"
+) -> Dict[str, jax.Array]:
+    shape = (cfg.num_layers, n_slots, max_len, cfg.num_kv_heads, cfg.head_dim_)
+    return {
+        "k": jnp.zeros(shape, jnp.dtype(dtype)),
+        "v": jnp.zeros(shape, jnp.dtype(dtype)),
+    }
+
+
+def forward_prefill(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,  # [S, P] padded prompt bucket (may be 1 row)
+    prompt_lens: jax.Array,  # [S]
+    cache: Dict[str, jax.Array],
+    slot_offset: jax.Array,  # scalar: first cache slot these rows occupy
+):
+    """Prefill `input_ids` into cache slots [slot_offset, slot_offset+S);
+    returns (last-token logits [S, V], updated cache)."""
+    S, P = input_ids.shape
+    dtype = jnp.dtype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (S, P))
+    valid = positions < prompt_lens[:, None]
+    seg = jnp.where(valid, 0, -1)
+    mask = make_attention_mask(seg, positions, cfg.sliding_window)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+    x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+
+    def layer(x, xs):
+        lp, ck, cv = xs  # ck/cv: [S_total, M, Hkv, hd] for this layer
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h, dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype), (slot_offset, 0, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype), (slot_offset, 0, 0, 0)
+        )
+        attn = attention(q, k, v, mask, cfg.attn_logit_softcap)
+        x = x + jnp.einsum(
+            "bth,hd->btd", attn.reshape(S, P, cfg.q_size), lp["attn"]["wo"].astype(dtype)
+        )
+        h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h, dtype)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # logits only at each row's final real token
+    idx = jnp.maximum(prompt_lens - 1, 0)
+    last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bd,dv->bv", last, head.astype(dtype))
+    return logits, {"k": new_k, "v": new_v}
+
+
+def forward_decode(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [S] last generated token per slot
+    lengths: jax.Array,  # [S] current sequence length (cache fill) per slot
+    cache: Dict[str, jax.Array],
+):
+    """One decode step for every slot; returns (logits [S, V], new cache).
+    The new token's K/V is written at cache position `lengths[s]`."""
+    S = tokens.shape[0]
+    M = cache["k"].shape[2]
+    dtype = jnp.dtype(cfg.dtype)
+    positions = lengths[:, None].astype(jnp.int32)  # [S, 1]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+    x = jnp.take(params["embedding"].astype(dtype), tokens[:, None], axis=0)
+    # attend to cache positions 0..lengths (inclusive: self just written)
+    key_pos = jnp.arange(M, dtype=jnp.int32)[None, :]
+    attn_mask = (key_pos <= lengths[:, None])[:, None, None, :]  # [S,1,1,M]
+    if cfg.sliding_window is not None:
+        attn_mask &= (key_pos > positions - cfg.sliding_window)[:, None, None, :]
+    slots = jnp.arange(S)
+
+    def layer(x, xs):
+        lp, ck, cv = xs
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h, dtype)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = ck.at[slots, lengths].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[slots, lengths].set(v[:, 0].astype(cv.dtype))
+        attn = attention(
+            q, ck.astype(dtype), cv.astype(dtype), attn_mask, cfg.attn_logit_softcap
+        )
+        x = x + jnp.einsum(
+            "bth,hd->btd", attn.reshape(S, 1, cfg.q_size), lp["attn"]["wo"].astype(dtype)
+        )
+        h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h, dtype)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(dtype))
+    return logits, {"k": new_k, "v": new_v}
 
 
 # ---------------------------------------------------------------------------
