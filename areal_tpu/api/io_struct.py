@@ -127,6 +127,9 @@ class WeightUpdateMeta:
     alloc_mode: Optional["AllocationMode"] = None
     chunk_mb: int = 256
     use_lora: bool = False
+    # identify the trial for the name_resolve version handshake
+    experiment_name: str = ""
+    trial_name: str = ""
 
     @classmethod
     def from_disk(
@@ -145,7 +148,13 @@ class WeightUpdateMeta:
             name,
             "weight_update",
         )
-        return cls(type="disk", path=path, use_lora=use_lora)
+        return cls(
+            type="disk",
+            path=path,
+            use_lora=use_lora,
+            experiment_name=experiment_name,
+            trial_name=trial_name,
+        )
 
     @classmethod
     def from_transfer(

@@ -40,6 +40,21 @@ def _get_pool() -> ProcessPoolExecutor:
         return _pool
 
 
+def _noop() -> int:
+    return 0
+
+
+def prewarm_reward_pool(timeout: float = 120.0) -> None:
+    """Spin up the spawn workers ahead of the first real reward call: worker
+    bootstrap (re-importing the reward fn's module, often pulling in jax)
+    can exceed the per-call reward timeout and silently zero the first
+    batch's rewards."""
+    pool = _get_pool()
+    futs = [pool.submit(_noop) for _ in range(_MAX_WORKERS)]
+    for f in futs:
+        f.result(timeout=timeout)
+
+
 def _recreate_pool():
     global _pool
     with _pool_lock:

@@ -1,0 +1,36 @@
+"""Dataset loaders (reference: areal/dataset/__init__.py get_custom_dataset
+dispatch + per-dataset modules)."""
+
+from typing import Any, Callable, Dict, Optional
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_dataset(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_custom_dataset(
+    path: str,
+    type: str = "",
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    """Dispatch on dataset `type` (e.g. "gsm8k", "jsonl"); `path` is a local
+    directory/file or an HF dataset id (works offline when cached)."""
+    key = type or path
+    for name, fn in _REGISTRY.items():
+        if name == key or name in key:
+            return fn(path=path, split=split, tokenizer=tokenizer,
+                      max_length=max_length, **kwargs)
+    raise ValueError(f"unknown dataset type {key!r}; known: {sorted(_REGISTRY)}")
+
+
+from areal_tpu.dataset import gsm8k as _gsm8k  # noqa: E402,F401  (registers)
+from areal_tpu.dataset import jsonl as _jsonl  # noqa: E402,F401

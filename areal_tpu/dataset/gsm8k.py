@@ -1,0 +1,56 @@
+"""GSM8K math dataset (reference: areal/dataset/gsm8k.py).
+
+Yields dicts {messages, query_id, answer}; the RLVR workflow tokenizes via
+the chat template and the reward fn checks the final "#### N" answer.
+"""
+
+import re
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+PROMPT_SUFFIX = (
+    "\nPlease reason step by step, and put your final answer within \\boxed{}."
+)
+
+
+def gsm8k_answer(solution: str) -> str:
+    m = re.search(r"####\s*([\-0-9\.,/]+)", solution)
+    return m.group(1).replace(",", "").strip() if m else solution.strip()
+
+
+@register_dataset("gsm8k")
+def load_gsm8k(
+    path: str = "openai/gsm8k",
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    import datasets as hf_datasets
+
+    if path.endswith(".jsonl") or path.endswith(".json"):
+        ds = hf_datasets.load_dataset("json", data_files=path, split="train")
+    else:
+        ds = hf_datasets.load_dataset(path, "main", split=split)
+
+    def to_sample(x, idx):
+        return {
+            "messages": [
+                {"role": "user", "content": x["question"] + PROMPT_SUFFIX}
+            ],
+            "query_id": str(idx),
+            "answer": gsm8k_answer(x["answer"]),
+        }
+
+    ds = ds.map(to_sample, with_indices=True, remove_columns=ds.column_names)
+    if max_length is not None and tokenizer is not None:
+        ds = ds.filter(
+            lambda x: len(
+                tokenizer.apply_chat_template(
+                    x["messages"], add_generation_prompt=True, tokenize=True
+                )
+            )
+            <= max_length
+        )
+    return ds

@@ -1,0 +1,39 @@
+"""Generic JSONL prompt dataset: each line {prompt|messages, answer?, ...}."""
+
+import json
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+
+@register_dataset("jsonl")
+def load_jsonl(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            x = json.loads(line)
+            x.setdefault("query_id", str(i))
+            rows.append(x)
+    if max_length is not None and tokenizer is not None:
+        rows = [
+            x
+            for x in rows
+            if len(
+                tokenizer.apply_chat_template(
+                    x["messages"], add_generation_prompt=True, tokenize=True
+                )
+                if "messages" in x
+                else tokenizer.encode(x["prompt"])
+            )
+            <= max_length
+        ]
+    return rows

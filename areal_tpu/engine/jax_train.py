@@ -445,11 +445,13 @@ class JaxTrainEngine(TrainEngine):
         inference clients/servers can reload."""
         if meta.type != "disk":
             raise NotImplementedError("transfer path lands with the gen server")
-        path = os.path.join(meta.path, str(self._version))
+        # same dir every update (reference behavior: fsdp_engine.py:403-425) —
+        # clients pass meta.path verbatim to servers; pause() serialises
+        # overwrite vs. reload
         save_hf_checkpoint(
             self._host_params(),
             self.model_config,
-            path,
+            meta.path,
             save_dtype="bfloat16",
             tokenizer_src=self.config.path or None,
         )

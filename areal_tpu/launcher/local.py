@@ -1,0 +1,165 @@
+"""Local launcher: one host, generation servers + trainer as subprocesses.
+
+Behavioral counterpart of the reference's `LocalLauncher`
+(areal/launcher/local.py:81): parse the allocation expression, start the
+LLM servers (here `areal_tpu.gen.server`), register/discover addresses via
+name_resolve env plumbing, start the trainer entrypoint, babysit everything,
+and relaunch the whole run on failure (auto-recover loop,
+RECOVER_TIME_INTERVAL) up to `recover.retries` times with AREAL_RUN_ID
+incremented so `check_if_recover` (utils/recover.py) resumes from the dump.
+
+Usage:
+    python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v ...]
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.api.alloc import AllocationMode
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging, name_resolve, names, network
+
+logger = logging.getLogger("launcher.local")
+
+RECOVER_TIME_INTERVAL = 10.0
+
+
+class LocalLauncher:
+    def __init__(self, entry: str, config_args: List[str]):
+        self.entry = entry
+        self.config_args = config_args
+        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.procs: List[subprocess.Popen] = []
+        self.server_addrs: List[str] = []
+
+    # ------------------------------------------------------------------
+
+    def _spawn(self, cmd: List[str], env: Optional[Dict[str, str]] = None,
+               tag: str = "") -> subprocess.Popen:
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        log_dir = os.path.join(
+            self.config.cluster.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "logs",
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"{tag}.log")
+        log_f = open(log_path, "a")
+        logger.info(f"spawn [{tag}]: {' '.join(cmd)} (log: {log_path})")
+        p = subprocess.Popen(
+            cmd, env=full_env, stdout=log_f, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        self.procs.append(p)
+        return p
+
+    def start_gen_servers(self, n_servers: int) -> List[str]:
+        addrs = []
+        for idx in range(n_servers):
+            port = network.find_free_port()
+            cmd = [
+                sys.executable, "-m", "areal_tpu.gen.server",
+                "--model-path", self.config.gen_server.model_path,
+                "--port", str(port),
+                "--n-slots", str(self.config.gen_server.max_seqs),
+                "--max-seq-len", str(self.config.gen_server.max_context_len),
+                "--experiment-name", self.config.experiment_name,
+                "--trial-name", self.config.trial_name,
+                "--server-idx", str(idx),
+            ]
+            self._spawn(cmd, tag=f"gen_server_{idx}")
+            addrs.append(f"127.0.0.1:{port}")
+        return addrs
+
+    def start_trainer(self, server_addrs: List[str], run_id: int) -> subprocess.Popen:
+        env = {
+            "AREAL_LLM_SERVER_ADDRS": ",".join(server_addrs),
+            "AREAL_RUN_ID": str(run_id),
+        }
+        cmd = [sys.executable, self.entry, *self.config_args]
+        return self._spawn(cmd, env=env, tag=f"trainer_run{run_id}")
+
+    def stop_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        self.procs.clear()
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> int:
+        alloc = None
+        if self.config.allocation_mode:
+            alloc = AllocationMode.from_str(self.config.allocation_mode)
+        n_servers = 1
+        if alloc is not None and alloc.gen is not None:
+            n_servers = max(1, alloc.gen.dp_size)
+
+        retries = max(1, self.config.recover.retries)
+        run_id = int(os.environ.get("AREAL_RUN_ID", 0))
+        rc = 1
+        try:
+            while run_id < retries:
+                self.server_addrs = self.start_gen_servers(n_servers)
+                trainer = self.start_trainer(self.server_addrs, run_id)
+                rc = self._babysit(trainer)
+                self.stop_all()
+                if rc == 0:
+                    logger.info("trainer finished successfully")
+                    return 0
+                run_id += 1
+                if run_id < retries and self.config.recover.mode in ("auto", "fault"):
+                    logger.warning(
+                        f"trainer exited rc={rc}; relaunching (run {run_id}) "
+                        f"in {RECOVER_TIME_INTERVAL}s"
+                    )
+                    time.sleep(RECOVER_TIME_INTERVAL)
+                else:
+                    break
+            return rc
+        finally:
+            self.stop_all()
+
+    def _babysit(self, trainer: subprocess.Popen) -> int:
+        """Wait for the trainer; if any gen server dies first, fail the run."""
+        while True:
+            rc = trainer.poll()
+            if rc is not None:
+                return rc
+            for p in self.procs:
+                if p is not trainer and p.poll() is not None:
+                    logger.error("a generation server died; restarting run")
+                    return 1
+            time.sleep(1.0)
+
+
+def main():
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(2)
+    entry, args = sys.argv[1], sys.argv[2:]
+    launcher = LocalLauncher(entry, args)
+    sys.exit(launcher.run())
+
+
+if __name__ == "__main__":
+    main()

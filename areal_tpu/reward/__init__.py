@@ -1,0 +1,13 @@
+from areal_tpu.reward.math_parser import (
+    extract_answer,
+    gsm8k_reward_fn,
+    math_equal,
+    math_verify_reward,
+)
+
+__all__ = [
+    "extract_answer",
+    "math_equal",
+    "gsm8k_reward_fn",
+    "math_verify_reward",
+]

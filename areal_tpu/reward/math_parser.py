@@ -1,0 +1,160 @@
+"""Math answer extraction and verification.
+
+Behavioral counterpart of the reference's rule-based math verifier
+(areal/reward/math_parser.py, 867 LoC with vendored latex2sympy;
+realhf/impl/model/interface/math_rw_interface.py): extract the model's final
+answer (\\boxed{...}, "the answer is", or trailing expression), normalise
+latex/number formatting, and compare against ground truth — string match,
+then numeric, then sympy symbolic equivalence.
+
+Runs inside the reward process pool (api/reward.py), so sympy hangs are
+bounded by the pool timeout rather than an in-process alarm.
+"""
+
+import re
+from typing import Optional
+
+# --------------------------------------------------------------------------
+# extraction
+# --------------------------------------------------------------------------
+
+
+def _find_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} / \\fbox{...} content, brace-balanced."""
+    idx = max(text.rfind("\\boxed"), text.rfind("\\fbox"))
+    if idx < 0:
+        return None
+    brace = text.find("{", idx)
+    if brace < 0:
+        # \boxed 42 form
+        m = re.match(r"\\boxed\s+(\S+)", text[idx:])
+        return m.group(1) if m else None
+    depth = 0
+    for i in range(brace, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[brace + 1 : i]
+    return None
+
+
+_ANSWER_PATTERNS = [
+    r"(?:final answer|the answer)\s*(?:is|:)?\s*([^\n\.]+)",
+    r"####\s*([^\n]+)",
+]
+
+
+def extract_answer(text: str) -> Optional[str]:
+    boxed = _find_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    low = text.lower()
+    for pat in _ANSWER_PATTERNS:
+        matches = list(re.finditer(pat, low))
+        if matches:
+            m = matches[-1]
+            return text[m.start(1) : m.end(1)].strip()
+    # fall back to the last number in the text
+    nums = re.findall(r"-?\d[\d,]*(?:\.\d+)?", text)
+    return nums[-1] if nums else None
+
+
+# --------------------------------------------------------------------------
+# normalisation & comparison
+# --------------------------------------------------------------------------
+
+_LATEX_SUBS = [
+    (r"\\left|\\right", ""),
+    (r"\\!|\\,|\\;|\\:|~", ""),
+    (r"\\text\{([^{}]*)\}", r"\1"),
+    (r"\\mathrm\{([^{}]*)\}", r"\1"),
+    (r"\\mbox\{([^{}]*)\}", r"\1"),
+    (r"\\\$|\$", ""),
+    (r"\\%|%", ""),
+    (r"\\dfrac", r"\\frac"),
+    (r"\\tfrac", r"\\frac"),
+    (r"\\cdot", "*"),
+    (r"\\times", "*"),
+    (r"\\div", "/"),
+    (r"\\pi", "pi"),
+    (r"\\infty", "oo"),
+    (r"\\circ", ""),
+    (r"\\degree", ""),
+    (r"\s+", ""),
+]
+
+
+def normalize_answer(ans: str) -> str:
+    s = ans.strip()
+    for pat, rep in _LATEX_SUBS:
+        s = re.sub(pat, rep, s)
+    # \frac{a}{b} -> (a)/(b)
+    while True:
+        m = re.search(r"\\frac\{([^{}]*)\}\{([^{}]*)\}", s)
+        if not m:
+            break
+        s = s[: m.start()] + f"(({m.group(1)})/({m.group(2)}))" + s[m.end() :]
+    s = re.sub(r"\\sqrt\{([^{}]*)\}", r"sqrt(\1)", s)
+    s = re.sub(r"\\sqrt(\w)", r"sqrt(\1)", s)
+    s = s.replace("^", "**").replace("{", "(").replace("}", ")")
+    s = s.replace(",", "")  # thousands separators
+    s = s.rstrip(".")
+    if s.endswith("(") or s.endswith(")") and s.count("(") != s.count(")"):
+        s = s.strip("()")
+    return s.lower()
+
+
+def _to_number(s: str) -> Optional[float]:
+    try:
+        return float(s)
+    except (ValueError, TypeError):
+        pass
+    m = re.fullmatch(r"\(*\(?(-?[\d\.]+)\)?/\(?(-?[\d\.]+)\)?\)*", s)
+    if m:
+        try:
+            return float(m.group(1)) / float(m.group(2))
+        except (ValueError, ZeroDivisionError):
+            return None
+    return None
+
+
+def math_equal(pred: str, target: str, rel_tol: float = 1e-4) -> bool:
+    if pred is None or target is None:
+        return False
+    p, t = normalize_answer(str(pred)), normalize_answer(str(target))
+    if p == t:
+        return True
+    pn, tn = _to_number(p), _to_number(t)
+    if pn is not None and tn is not None:
+        return abs(pn - tn) <= rel_tol * max(1.0, abs(tn))
+    if (pn is None) != (tn is None):
+        # one side numeric, other symbolic: let sympy decide
+        pass
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import parse_expr
+
+        diff = sympy.simplify(parse_expr(p) - parse_expr(t))
+        return diff == 0
+    except Exception:  # noqa: BLE001 — unparseable => not equal
+        return False
+
+
+# --------------------------------------------------------------------------
+# reward functions (signature: prompt, completion, prompt_ids, completion_ids,
+# **data -> float; reference: areal/reward usage in workflows)
+# --------------------------------------------------------------------------
+
+
+def gsm8k_reward_fn(prompt, completions, prompt_ids, completion_ids, answer, **kw):
+    pred = extract_answer(completions)
+    return float(pred is not None and math_equal(pred, answer))
+
+
+def math_verify_reward(prompt, completions, prompt_ids, completion_ids, solution=None,
+                       answer=None, **kw):
+    target = answer if answer is not None else extract_answer(solution or "")
+    pred = extract_answer(completions)
+    return float(pred is not None and target is not None and math_equal(pred, target))

@@ -1,0 +1,37 @@
+"""Frequency-controlled evaluation callback
+(reference: areal/utils/evaluator.py `Evaluator`)."""
+
+from typing import Callable, Optional
+
+from areal_tpu.api.config import EvaluatorConfig
+from areal_tpu.utils import logging
+from areal_tpu.utils.timer import FrequencyControl
+
+logger = logging.getLogger("evaluator")
+
+
+class Evaluator:
+    def __init__(self, config: EvaluatorConfig, ft_spec=None):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.freq = FrequencyControl(config)
+
+    def evaluate(
+        self,
+        evaluate_fn: Callable[[], Optional[dict]],
+        epoch: int,
+        epoch_step: int,
+        global_step: int,
+        force: bool = False,
+    ) -> Optional[dict]:
+        if not self.freq.check(epoch, global_step, force=force):
+            return None
+        result = evaluate_fn()
+        logger.info(f"eval @ step {global_step}: {result}")
+        return result
+
+    def state_dict(self):
+        return {"freq": self.freq.state_dict()}
+
+    def load_state_dict(self, state):
+        self.freq.load_state_dict(state["freq"])

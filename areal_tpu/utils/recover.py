@@ -1,0 +1,146 @@
+"""Checkpoint/resume for interrupted experiments.
+
+Behavioral counterpart of the reference's `RecoverHandler`
+(areal/utils/recover.py:139): dump = engine checkpoint with optimizer state
++ dataloader position + saver/evaluator/stats-logger state + RecoverInfo;
+load = restore all of it and replay the weight upload to (fresh) inference
+servers; `check_if_recover` (:373) decides whether a run should resume.
+"""
+
+import json
+import os
+import pickle
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+from areal_tpu.api.config import RecoverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, WeightUpdateMeta
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("recover")
+
+
+@dataclass
+class RecoverInfo:
+    """(reference: recover.py RecoverInfo:29)"""
+
+    recover_start: StepInfo
+    last_step_info: StepInfo
+    saver_info: Dict[str, Any] = field(default_factory=dict)
+    checkpointer_info: Dict[str, Any] = field(default_factory=dict)
+    evaluator_info: Dict[str, Any] = field(default_factory=dict)
+    stats_logger_info: Dict[str, Any] = field(default_factory=dict)
+    dataloader_info: Dict[str, Any] = field(default_factory=dict)
+    hash_vals_to_ignore: list = field(default_factory=list)
+
+
+class RecoverHandler:
+    def __init__(self, config: RecoverConfig, ft_spec=None):
+        self.config = config
+        self.ft_spec = ft_spec
+
+    def recover_root(self) -> str:
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "recover",
+        )
+
+    def _info_path(self) -> str:
+        return os.path.join(self.recover_root(), "recover_info.pkl")
+
+    def dump(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        stats_logger=None,
+        dataloader=None,
+        tokenizer=None,
+    ) -> str:
+        root = self.recover_root()
+        ckpt = os.path.join(root, "checkpoint")
+        os.makedirs(ckpt, exist_ok=True)
+        engine.save(SaveLoadMeta(path=ckpt, with_optim=True, tokenizer=tokenizer))
+        info = RecoverInfo(
+            recover_start=StepInfo(
+                epoch=step_info.epoch,
+                epoch_step=step_info.epoch_step + 1,
+                global_step=step_info.global_step + 1,
+                steps_per_epoch=step_info.steps_per_epoch,
+            ),
+            last_step_info=step_info,
+            saver_info=saver.state_dict() if saver else {},
+            evaluator_info=evaluator.state_dict() if evaluator else {},
+            stats_logger_info=stats_logger.state_dict() if stats_logger else {},
+            dataloader_info=dataloader.state_dict() if dataloader else {},
+        )
+        with open(self._info_path(), "wb") as f:
+            pickle.dump(info, f)
+        with open(os.path.join(root, "recover_info.json"), "w") as f:
+            json.dump(
+                {"last_step_info": asdict(info.last_step_info)}, f
+            )
+        logger.info(f"dumped recover checkpoint @ step {step_info.global_step}")
+        return root
+
+    def load(
+        self,
+        engine,
+        saver=None,
+        evaluator=None,
+        stats_logger=None,
+        dataloader=None,
+        inference_engine=None,
+        weight_update_meta: Optional[WeightUpdateMeta] = None,
+    ) -> Optional[RecoverInfo]:
+        """Restore everything; if an inference engine is given, replay the
+        weight upload so fresh servers serve the recovered policy."""
+        path = self._info_path()
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            info: RecoverInfo = pickle.load(f)
+        ckpt = os.path.join(self.recover_root(), "checkpoint")
+        engine.load(SaveLoadMeta(path=ckpt, with_optim=True))
+        if saver is not None and info.saver_info:
+            saver.load_state_dict(info.saver_info)
+        if evaluator is not None and info.evaluator_info:
+            evaluator.load_state_dict(info.evaluator_info)
+        if stats_logger is not None and info.stats_logger_info:
+            stats_logger.load_state_dict(info.stats_logger_info)
+        if dataloader is not None and info.dataloader_info:
+            dataloader.load_state_dict(info.dataloader_info)
+        version = info.last_step_info.global_step + 1
+        engine.set_version(version)
+        if inference_engine is not None and weight_update_meta is not None:
+            engine.update_weights(weight_update_meta)
+            inference_engine.update_weights(weight_update_meta)
+            inference_engine.set_version(version)
+        logger.info(
+            f"recovered from step {info.last_step_info.global_step}; "
+            f"resuming at {info.recover_start.global_step}"
+        )
+        return info
+
+
+def check_if_recover(config: RecoverConfig, run_id: int = 0) -> bool:
+    """Should this launch resume from a recover checkpoint?
+    (reference: recover.py:373)"""
+    if config.mode == "disabled":
+        return False
+    info_path = os.path.join(
+        config.fileroot, config.experiment_name, config.trial_name,
+        "recover", "recover_info.pkl",
+    )
+    exists = os.path.exists(info_path)
+    if config.mode == "resume":
+        return exists
+    if config.mode == "auto":
+        return exists
+    if config.mode == "fault":
+        # only recover on relaunch (run_id > 0), not on a fresh submit
+        return exists and run_id > 0
+    return False

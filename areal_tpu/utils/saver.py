@@ -1,0 +1,70 @@
+"""Frequency-controlled HF-format checkpoint saving
+(reference: areal/utils/saver.py `Saver`)."""
+
+import os
+from typing import Optional
+
+from areal_tpu.api.config import SaverConfig
+from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging
+from areal_tpu.utils.timer import FrequencyControl
+
+logger = logging.getLogger("saver")
+
+
+class Saver:
+    def __init__(self, config: SaverConfig, ft_spec=None, for_recover: bool = False):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.for_recover = for_recover
+        self.freq = FrequencyControl(config)
+
+    def save_root(self) -> str:
+        return os.path.join(
+            self.config.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "recover_checkpoints" if self.for_recover else "checkpoints",
+        )
+
+    def save_path(self, step_info: StepInfo, name: str = "default") -> str:
+        return os.path.join(
+            self.save_root(),
+            name,
+            f"epoch{step_info.epoch}epochstep{step_info.epoch_step}"
+            f"globalstep{step_info.global_step}",
+        )
+
+    def save(
+        self,
+        engine,
+        epoch: int,
+        epoch_step: int,
+        global_step: int,
+        name: str = "default",
+        force: bool = False,
+        with_optim: Optional[bool] = None,
+        tokenizer=None,
+    ) -> Optional[str]:
+        """Save if the frequency budget elapsed; returns the path if saved."""
+        if not self.freq.check(epoch, global_step, force=force):
+            return None
+        step_info = StepInfo(
+            epoch=epoch, epoch_step=epoch_step, global_step=global_step,
+            steps_per_epoch=self.ft_spec.steps_per_epoch if self.ft_spec else 0,
+        )
+        path = self.save_path(step_info, name)
+        os.makedirs(path, exist_ok=True)
+        engine.save(SaveLoadMeta(
+            path=path,
+            with_optim=self.for_recover if with_optim is None else with_optim,
+            tokenizer=tokenizer,
+        ))
+        logger.info(f"saved checkpoint: {path}")
+        return path
+
+    def state_dict(self):
+        return {"freq": self.freq.state_dict()}
+
+    def load_state_dict(self, state):
+        self.freq.load_state_dict(state["freq"])

@@ -1,0 +1,180 @@
+"""GSM8K GRPO — the canonical train loop.
+
+Line-for-line behavioral counterpart of the reference's
+`examples/math/gsm8k_grpo.py:34-255`: load config → connect rollout client →
+init actor (+ optional ref) → per step: prepare_batch (async) or
+rollout_batch (sync), recompute prox logp, compute advantages, ppo_update,
+push weights, save/eval/recover, log stats.
+
+Launch:  python examples/math/gsm8k_grpo.py --config examples/math/gsm8k_grpo.yaml
+(or via the launcher, which also starts generation servers:
+ python -m areal_tpu.launcher.local examples/math/gsm8k_grpo.py --config ...)
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.engine.ppo import JaxPPOActor
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.reward import gsm8k_reward_fn
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.evaluator import Evaluator
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+logger = logging.getLogger("gsm8k_grpo")
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, GRPOConfig)
+    seeding.set_random_seed(config.seed, "trainer")
+
+    tokenizer = None
+    if config.tokenizer_path:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(config.tokenizer_path)
+
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type,
+        split="train",
+        tokenizer=tokenizer,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    # rollout client against the generation servers
+    rollout = RemoteJaxEngine(config.rollout)
+    rollout.initialize(train_data_parallel_size=1)
+
+    actor = JaxPPOActor(config.actor)
+    actor.create_process_group()
+    actor.initialize(ft_spec=ft_spec)
+
+    weight_meta = WeightUpdateMeta.from_disk(
+        config.experiment_name, config.trial_name, config.cluster.fileroot
+    )
+
+    from areal_tpu.api.reward import prewarm_reward_pool
+
+    prewarm_reward_pool()
+    workflow = RLVRWorkflow(
+        reward_fn=gsm8k_reward_fn,
+        gconfig=config.gconfig,
+        tokenizer=tokenizer,
+        dump_dir=os.path.join(
+            StatsLogger.get_log_path(config.stats_logger), "generated"
+        ),
+    )
+
+    saver = Saver(config.saver, ft_spec)
+    checkpointer = Saver(config.checkpointer, ft_spec, for_recover=True)
+    evaluator = Evaluator(config.evaluator, ft_spec)
+    stats_logger = StatsLogger(config.stats_logger)
+    recover = RecoverHandler(config.recover, ft_spec)
+
+    start_step = 0
+    if check_if_recover(config.recover, run_id=int(os.environ.get("AREAL_RUN_ID", 0))):
+        info = recover.load(
+            actor,
+            saver=saver,
+            evaluator=evaluator,
+            stats_logger=stats_logger,
+            dataloader=dataloader,
+            inference_engine=rollout,
+            weight_update_meta=weight_meta,
+        )
+        if info is not None:
+            start_step = info.recover_start.global_step
+
+    total_steps = config.total_train_steps or ft_spec.total_train_steps
+    steps_per_epoch = ft_spec.steps_per_epoch
+
+    for global_step in range(start_step, total_steps):
+        epoch = global_step // steps_per_epoch
+        epoch_step = global_step % steps_per_epoch
+        step_info = StepInfo(
+            epoch=epoch, epoch_step=epoch_step, global_step=global_step,
+            steps_per_epoch=steps_per_epoch,
+        )
+
+        with stats.record_timing("rollout"):
+            if config.async_training:
+                batch = rollout.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = rollout.rollout_batch(
+                    next(iter_or_cycle(dataloader)), workflow=workflow
+                )
+
+        if config.actor.recompute_logprob:
+            with stats.record_timing("recompute_logp"):
+                batch["prox_logp"] = actor.compute_logp(batch)
+
+        with stats.record_timing("compute_advantages"):
+            actor.compute_advantages(batch)
+
+        with stats.record_timing("ppo_update"):
+            train_stats = actor.ppo_update(batch)
+            actor.step_lr_scheduler()
+
+        with stats.record_timing("update_weights"):
+            rollout.pause()
+            actor.set_version(global_step + 1)
+            actor.update_weights(weight_meta)
+            rollout.update_weights(weight_meta)
+            rollout.set_version(global_step + 1)
+            rollout.resume()
+
+        with stats.record_timing("save_eval"):
+            saver.save(actor, epoch, epoch_step, global_step, tokenizer=tokenizer)
+            if checkpointer.freq.check(epoch, global_step):
+                recover.dump(
+                    actor, step_info, saver=saver, evaluator=evaluator,
+                    stats_logger=stats_logger, dataloader=dataloader,
+                    tokenizer=tokenizer,
+                )
+
+        reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
+        stats.scalar(reward=reward_mean, n_seqs=len(batch.get("rewards", [])))
+        stats_logger.commit(
+            epoch, epoch_step, global_step,
+            [stats.export()] + train_stats,
+        )
+        logger.info(
+            f"Epoch {epoch + 1}/{config.total_train_epochs} "
+            f"Step {epoch_step + 1}/{steps_per_epoch} "
+            f"(global {global_step + 1}/{total_steps}) done. "
+            f"reward={reward_mean:.3f}"
+        )
+
+    rollout.destroy()
+    stats_logger.close()
+
+
+def iter_or_cycle(dataloader):
+    while True:
+        yield from dataloader
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,83 @@
+"""Offline test fixtures: tiny tokenizer + tiny HF checkpoint dir.
+
+The reference builds fixtures the same way (realhf/tests/fixtures.py trains
+a fresh WordPiece tokenizer and saves a cpu-sized model) because CI has no
+network access.
+"""
+
+import json
+import os
+
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ message['role'] }}: {{ message['content'] }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}assistant: {% endif %}"
+)
+
+
+def make_tiny_tokenizer(out_dir: str, vocab_size: int = 256):
+    """Train a tiny byte-level BPE tokenizer on synthetic text and save it as
+    a transformers PreTrainedTokenizerFast with a simple chat template."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size,
+        special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [
+        "What is 1 + 1? The answer is 2.",
+        "Compute 3 * 4. #### 12",
+        "Please reason step by step, and put your final answer within \\boxed{}.",
+        "user assistant system: numbers 0 1 2 3 4 5 6 7 8 9 10 11 12 13",
+    ] * 50
+    tok.train_from_iterator(corpus, trainer)
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        eos_token="<|endoftext|>",
+        pad_token="<|endoftext|>",
+    )
+    fast.chat_template = CHAT_TEMPLATE
+    os.makedirs(out_dir, exist_ok=True)
+    fast.save_pretrained(out_dir)
+    return fast
+
+
+def make_tiny_ckpt(out_dir: str, vocab_size: int = 384, seed: int = 0):
+    """Tiny Qwen2-style checkpoint dir (weights + config + tokenizer) that
+    both the train engine and the generation server can load."""
+    import jax
+
+    from areal_tpu.models import init_params
+    from areal_tpu.models.hf import save_hf_checkpoint
+    from areal_tpu.models.model_config import tiny_config
+
+    tokenizer = make_tiny_tokenizer(out_dir, vocab_size=256)
+    cfg = tiny_config(
+        vocab_size=vocab_size,
+        qkv_bias=True,
+        hf_architecture="Qwen2ForCausalLM",
+        eos_token_id=tokenizer.eos_token_id,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    save_hf_checkpoint(params, cfg, out_dir, save_dtype="float32")
+    return cfg
+
+
+def make_gsm8k_jsonl(path: str, n: int = 32):
+    rows = [
+        {
+            "question": f"What is {i} + {i + 1}?",
+            "answer": f"Adding gives {2 * i + 1}.\n#### {2 * i + 1}",
+        }
+        for i in range(n)
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return path

@@ -1,0 +1,176 @@
+"""Saver / Evaluator / RecoverHandler / FrequencyControl / datasets / math
+parser (reference analogs: areal/tests test_utils + recover behavior)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    EvaluatorConfig,
+    MeshConfig,
+    MicroBatchSpec,
+    OptimizerConfig,
+    RecoverConfig,
+    SaverConfig,
+    TimerConfig,
+    TrainEngineConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo
+from areal_tpu.engine.sft import JaxLMEngine
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.reward.math_parser import extract_answer, math_equal
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.evaluator import Evaluator
+from areal_tpu.utils.recover import RecoverHandler, RecoverInfo, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.timer import FrequencyControl
+
+MODEL_CFG = tiny_config(vocab_size=64, qkv_bias=True, hf_architecture="Qwen2ForCausalLM")
+
+
+def _engine(lr=1e-2):
+    cfg = TrainEngineConfig(
+        experiment_name="t", trial_name="t", init_from_scratch=True,
+        dtype="float32", gradient_checkpointing=False, mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(), pack_length_quantum=16,
+        optimizer=OptimizerConfig(lr=lr, warmup_steps_proportion=0.0),
+    )
+    eng = JaxLMEngine(cfg, model_config=MODEL_CFG)
+    eng.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    return eng
+
+
+def test_frequency_control():
+    fc = FrequencyControl(TimerConfig(freq_steps=3))
+    hits = [fc.check(0, s) for s in range(1, 10)]
+    assert hits == [False, False, True, False, False, True, False, False, True]
+    fc2 = FrequencyControl(TimerConfig())  # never triggers without force
+    assert not fc2.check(5, 100)
+    assert fc2.check(5, 100, force=True)
+    state = fc.state_dict()
+    fc3 = FrequencyControl(TimerConfig(freq_steps=3))
+    fc3.load_state_dict(state)
+    assert fc3._last_step == fc._last_step
+
+
+def test_saver_paths_and_freq(tmp_path):
+    eng = _engine()
+    cfg = SaverConfig(experiment_name="e", trial_name="t",
+                      fileroot=str(tmp_path), freq_steps=2)
+    saver = Saver(cfg, FinetuneSpec(1, 64, 8))
+    assert saver.save(eng, 0, 0, 1) is None  # freq not reached
+    path = saver.save(eng, 0, 1, 2)
+    assert path is not None and os.path.exists(os.path.join(path, "config.json"))
+    assert "checkpoints" in path and "globalstep2" in path
+
+
+def test_evaluator_freq():
+    ev = Evaluator(EvaluatorConfig(freq_steps=2), None)
+    calls = []
+    out = ev.evaluate(lambda: calls.append(1) or {"x": 1.0}, 0, 0, 1)
+    assert out is None and not calls
+    out = ev.evaluate(lambda: calls.append(1) or {"x": 1.0}, 0, 1, 2)
+    assert out == {"x": 1.0} and calls
+
+
+def test_recover_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    eng = _engine()
+    for _ in range(3):
+        eng.train_lm(batch)
+    eng.set_version(3)
+
+    cfg = RecoverConfig(mode="auto", experiment_name="e", trial_name="t",
+                        fileroot=str(tmp_path))
+    handler = RecoverHandler(cfg)
+    dataloader = StatefulDataLoader(list(range(32)), batch_size=4, seed=0)
+    it = iter(dataloader)
+    next(it), next(it)
+    step = StepInfo(epoch=0, epoch_step=2, global_step=2, steps_per_epoch=8)
+    saver = Saver(SaverConfig(experiment_name="e", trial_name="t",
+                              fileroot=str(tmp_path), freq_steps=2))
+    handler.dump(eng, step, saver=saver, dataloader=dataloader)
+    assert check_if_recover(cfg)
+    logp_ref = eng.forward(batch)
+
+    eng2 = _engine()
+    dataloader2 = StatefulDataLoader(list(range(32)), batch_size=4, seed=0)
+    info = handler.load(eng2, dataloader=dataloader2)
+    assert info is not None
+    assert info.recover_start.global_step == 3
+    assert eng2.get_version() == 3
+    assert eng2.step_count == eng.step_count
+    assert dataloader2.state_dict() == dataloader.state_dict()
+    np.testing.assert_allclose(eng2.forward(batch), logp_ref, rtol=1e-4, atol=1e-4)
+
+    # both engines continue identically
+    s1, s2 = eng.train_lm(batch), eng2.train_lm(batch)
+    np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-4)
+
+
+def test_check_if_recover_modes(tmp_path):
+    cfg = RecoverConfig(mode="disabled", experiment_name="e", trial_name="t",
+                        fileroot=str(tmp_path))
+    assert not check_if_recover(cfg)
+    cfg.mode = "fault"
+    os.makedirs(os.path.join(tmp_path, "e", "t", "recover"), exist_ok=True)
+    open(os.path.join(tmp_path, "e", "t", "recover", "recover_info.pkl"), "wb").close()
+    assert not check_if_recover(cfg, run_id=0)  # fresh submit
+    assert check_if_recover(cfg, run_id=1)  # relaunch
+    cfg.mode = "resume"
+    assert check_if_recover(cfg, run_id=0)
+
+
+def test_jsonl_dataset(tmp_path):
+    from areal_tpu.dataset import get_custom_dataset
+
+    p = tmp_path / "d.jsonl"
+    with open(p, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({"prompt": f"q{i}", "answer": str(i)}) + "\n")
+    ds = get_custom_dataset(str(p), type="jsonl")
+    assert len(ds) == 5 and ds[0]["query_id"] == "0"
+
+
+def test_gsm8k_answer_extraction():
+    from areal_tpu.dataset.gsm8k import gsm8k_answer
+
+    assert gsm8k_answer("blah blah\n#### 1,234") == "1234"
+    assert gsm8k_answer("#### -3.5") == "-3.5"
+
+
+@pytest.mark.parametrize(
+    "pred,target,equal",
+    [
+        ("42", "42", True),
+        ("42.0", "42", True),
+        ("1,234", "1234", True),
+        ("\\frac{1}{2}", "0.5", True),
+        ("\\frac{1}{2}", "1/2", True),
+        ("0.333", "1/3", False),  # outside tolerance
+        ("x+1", "1+x", True),  # sympy symbolic
+        ("\\sqrt{4}", "2", True),
+        ("50\\%", "50", True),
+        ("$3.50", "3.5", True),
+        ("7", "8", False),
+        ("nonsense[", "42", False),
+    ],
+)
+def test_math_equal(pred, target, equal):
+    assert math_equal(pred, target) == equal
+
+
+def test_extract_answer():
+    assert extract_answer("stuff \\boxed{\\frac{1}{2}} end") == "\\frac{1}{2}"
+    assert extract_answer("nested \\boxed{a{b}c}") == "a{b}c"
+    assert extract_answer("The answer is 42.") == "42"
+    assert extract_answer("compute... #### 17") == "17"
+    assert extract_answer("first 3 then 9 finally") == "9"
+    assert extract_answer("no numbers here") is None
