@@ -125,8 +125,14 @@ class PPOActor:
         # ---- shift to predictor alignment
         mask = _roll_back(mask_tok)
         mask[:, -1] = 0.0
-        old_logp = _roll_back(batch["logprobs"].astype(np.float32)) * mask
         prox_logp = batch.get("prox_logp")  # already predictor-aligned
+        if prox_logp is not None and not cfg.use_decoupled_loss:
+            # plain PPO with a recompute pass: the ratio must be taken
+            # against the recomputed policy, so the recomputed logprobs
+            # replace the inference engine's (reference: actor.py:103-106)
+            old_logp = np.asarray(prox_logp, np.float32) * mask
+        else:
+            old_logp = _roll_back(batch["logprobs"].astype(np.float32)) * mask
 
         # ---- token rewards: KL penalty + terminal reward (actor.py:119-135)
         tok_rewards = np.zeros((B, L), np.float32)

@@ -3,10 +3,12 @@
 Counterpart of the sampling the reference delegates to SGLang/vLLM servers
 (temperature / top-k / top-p / greedy, areal/api/cli_args.py
 GenerationHyperparameters).  Per-slot parameters are arrays so one compiled
-step serves heterogeneous requests; top-k/top-p run inside a static
-`TOPK_WINDOW`-wide candidate window (lax.top_k), which is exact whenever the
-nucleus fits the window — 64 candidates at temperature ≤ 1 covers it in
-practice.  Returned logprobs are exact full-vocab log-softmax values.
+step serves heterogeneous requests.  Unrestricted slots (top_k<=0 and
+top_p>=1) sample from the full-vocab categorical so the behavior
+distribution exactly matches the reported full-vocab log-softmax logprobs
+(the PPO importance ratios depend on this agreement); restricted slots run
+top-k/top-p inside a static `TOPK_WINDOW`-wide candidate window
+(lax.top_k), exact whenever the nucleus fits the window.
 """
 
 from typing import Dict
@@ -47,8 +49,18 @@ def sample_tokens(
     keep |= ranks == 0  # top_p=0 must mean near-greedy, never mask everything
     masked = jnp.where(keep, win_logits, NEG_INF)
 
-    choice = jax.random.categorical(rng, masked, axis=-1)  # [S] window index
+    rng_win, rng_full = jax.random.split(rng)
+    choice = jax.random.categorical(rng_win, masked, axis=-1)  # [S] window index
     sampled = jnp.take_along_axis(win_idx, choice[:, None], axis=-1)[:, 0]
+    # unrestricted slots: full-vocab categorical (behavior == reported
+    # logprobs); skipped entirely when every slot is restricted
+    unrestricted = (top_k <= 0) & (top_p >= 1.0)
+    full_sampled = jax.lax.cond(
+        jnp.any(unrestricted),
+        lambda: jax.random.categorical(rng_full, scaled, axis=-1),
+        lambda: sampled,
+    )
+    sampled = jnp.where(unrestricted, full_sampled, sampled)
     tokens = jnp.where(greedy, win_idx[:, 0], sampled)
 
     logz = jax.nn.logsumexp(scaled, axis=-1)

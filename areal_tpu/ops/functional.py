@@ -120,7 +120,8 @@ def ppo_actor_loss_fn(
     if c_clip is not None:
         # dual clip: bound the loss for very negative advantages
         pg_loss3 = jnp.sign(advantages) * c_clip * advantages
-        dual_clip_mask = pg_loss3 > pg_loss
+        # mask marks positions where the dual clip actually takes effect
+        dual_clip_mask = (advantages < 0) & (pg_loss3 < pg_loss)
         pg_loss = jnp.where(advantages < 0, jnp.minimum(pg_loss, pg_loss3), pg_loss)
     else:
         dual_clip_mask = jnp.zeros_like(clip_mask)

@@ -23,31 +23,37 @@ import numpy as np
 def gae_padded(
     rewards: jax.Array,  # [B, L]
     values: jax.Array,  # [B, L]
-    mask: jax.Array,  # [B, L] 1 where token is valid
+    mask: jax.Array,  # [B, L] loss mask; holes allowed (multi-turn)
     gamma: float,
     lam: float,
 ) -> Tuple[jax.Array, jax.Array]:
-    """GAE over right-padded batches; bootstrap value after the last valid
-    token is 0 (terminal).  Returns (advantages, returns) masked to 0 on pads.
+    """GAE over [B, L] batches; bootstrap value after the last masked token
+    is 0 (terminal).  Returns (advantages, returns) masked to 0 off-mask.
+
+    Positions with mask 0 — trailing padding *and* interior holes such as
+    multi-turn user tokens — are skipped exactly as the reference does
+    (areal/engine/ppo/actor.py:146-151): the accumulated lastgaelam and the
+    bootstrap value are frozen across them, so the recurrence connects each
+    loss token directly to the next loss token with a single gamma*lam step.
     """
     mask = mask.astype(jnp.float32)
     rewards = rewards.astype(jnp.float32) * mask
     values = values.astype(jnp.float32) * mask
-    # next value: V[t+1] if t+1 valid else 0
-    nxt = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1)
-    nxt_valid = jnp.concatenate([mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
-    delta = rewards + gamma * nxt * nxt_valid - values
+    B = rewards.shape[0]
 
     def step(carry, xs):
-        d, valid_next = xs
-        adv = d + gamma * lam * valid_next * carry
-        return adv, adv
+        lastgaelam, nextvalues = carry
+        r, v, m = xs
+        delta = r + gamma * nextvalues - v
+        newgaelam = delta + gamma * lam * lastgaelam
+        lastgaelam = m * newgaelam + (1.0 - m) * lastgaelam
+        nextvalues = m * v + (1.0 - m) * nextvalues
+        return (lastgaelam, nextvalues), lastgaelam
 
-    # reverse scan over time, batched over B via vmap-free transpose
+    # reverse scan over time, batched over B via transpose
+    init = (jnp.zeros(B, jnp.float32), jnp.zeros(B, jnp.float32))
     _, adv_rev = jax.lax.scan(
-        step,
-        jnp.zeros(rewards.shape[0], jnp.float32),
-        (delta.T[::-1], nxt_valid.T[::-1]),
+        step, init, (rewards.T[::-1], values.T[::-1], mask.T[::-1])
     )
     adv = adv_rev[::-1].T * mask
     returns = adv + values
@@ -60,32 +66,44 @@ def gae_segments(
     segment_ids: jax.Array,  # [T], -1 on filler
     gamma: float,
     lam: float,
+    loss_mask: Optional[jax.Array] = None,  # [T]; holes allowed
 ) -> Tuple[jax.Array, jax.Array]:
     """GAE over a packed flat buffer; boundaries where segment id changes.
 
     Equivalent to cugae's `gae_1d_nolp_misalign` with per-sequence terminal
-    bootstrap 0 (RLVR episodes end at the final token).
+    bootstrap 0 (RLVR episodes end at the final token).  `loss_mask` holes
+    inside a segment freeze the carry exactly as in `gae_padded`.
     """
     valid = segment_ids >= 0
-    rewards = jnp.where(valid, rewards.astype(jnp.float32), 0.0)
-    values = jnp.where(valid, values.astype(jnp.float32), 0.0)
+    m = valid.astype(jnp.float32)
+    if loss_mask is not None:
+        m = m * loss_mask.astype(jnp.float32)
+    rewards = rewards.astype(jnp.float32) * m
+    values = values.astype(jnp.float32) * m
+    # carry resets (to 0) at segment boundaries, scanning in reverse:
+    # position t is a boundary start if segment_ids[t] != segment_ids[t+1]
     nxt_same = jnp.concatenate(
         [(segment_ids[1:] == segment_ids[:-1]) & valid[1:], jnp.zeros((1,), bool)]
-    )
-    nxt = jnp.concatenate([values[1:], jnp.zeros((1,), jnp.float32)])
-    delta = rewards + gamma * nxt * nxt_same - values
+    ).astype(jnp.float32)
 
     def step(carry, xs):
-        d, same = xs
-        adv = d + gamma * lam * same * carry
-        return adv, adv
+        lastgaelam, nextvalues = carry
+        r, v, mm, same = xs
+        lastgaelam = lastgaelam * same
+        nextvalues = nextvalues * same
+        delta = r + gamma * nextvalues - v
+        newgaelam = delta + gamma * lam * lastgaelam
+        lastgaelam = mm * newgaelam + (1.0 - mm) * lastgaelam
+        nextvalues = mm * v + (1.0 - mm) * nextvalues
+        return (lastgaelam, nextvalues), lastgaelam
 
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
     _, adv_rev = jax.lax.scan(
-        step, jnp.zeros((), jnp.float32), (delta[::-1], nxt_same[::-1])
+        step, init, (rewards[::-1], values[::-1], m[::-1], nxt_same[::-1])
     )
-    adv = jnp.where(valid, adv_rev[::-1], 0.0)
+    adv = adv_rev[::-1] * m
     returns = adv + values
-    return adv, jnp.where(valid, returns, 0.0)
+    return adv, returns * m
 
 
 # ---------------------------------------------------------------------------

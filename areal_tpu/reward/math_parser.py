@@ -101,8 +101,17 @@ def normalize_answer(ans: str) -> str:
     s = s.replace("^", "**").replace("{", "(").replace("}", ")")
     s = s.replace(",", "")  # thousands separators
     s = s.rstrip(".")
-    if s.endswith("(") or s.endswith(")") and s.count("(") != s.count(")"):
-        s = s.strip("()")
+    # drop a single unbalanced paren at either end; never touch balanced ones
+    if s.count("(") > s.count(")"):
+        if s.endswith("("):
+            s = s[:-1]
+        elif s.startswith("("):
+            s = s[1:]
+    elif s.count(")") > s.count("("):
+        if s.startswith(")"):
+            s = s[1:]
+        elif s.endswith(")"):
+            s = s[:-1]
     return s.lower()
 
 

@@ -171,3 +171,103 @@ def test_kl_and_norm_utils():
     mask = np.ones_like(x)
     y = np.asarray(masked_normalize(x, mask))
     assert abs(y.mean()) < 1e-3 and abs(y.std() - 1.0) < 1e-2
+
+
+def _gae_holes_loop(rewards, values, mask, gamma, lam):
+    """Independent loop with the reference's frozen-carry hole semantics
+    (areal/engine/ppo/actor.py:146-151)."""
+    B, L = rewards.shape
+    adv = np.zeros((B, L), np.float64)
+    for b in range(B):
+        lastgaelam, nextvalues = 0.0, 0.0
+        for t in reversed(range(L)):
+            delta = rewards[b, t] + gamma * nextvalues - values[b, t]
+            newgaelam = delta + gamma * lam * lastgaelam
+            if mask[b, t]:
+                lastgaelam = newgaelam
+                nextvalues = values[b, t]
+                adv[b, t] = lastgaelam
+    return adv
+
+
+def test_gae_padded_freezes_carry_across_mask_holes():
+    """Multi-turn loss masks have interior holes (user tokens); the carry and
+    bootstrap must skip them, not decay through them."""
+    rng = np.random.default_rng(3)
+    B, L = 4, 16
+    mask = (rng.random((B, L)) > 0.4).astype(np.float32)
+    mask[:, -1] = 0.0
+    mask[:, 2] = 1.0  # ensure some loss tokens
+    rewards = rng.normal(size=(B, L)).astype(np.float32) * mask
+    values = rng.normal(size=(B, L)).astype(np.float32) * mask
+    adv, ret = gae_padded(rewards, values, mask, gamma=0.9, lam=0.8)
+    ref = _gae_holes_loop(rewards, values, mask, 0.9, 0.8)
+    np.testing.assert_allclose(np.asarray(adv), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), ref + values * mask, rtol=1e-5, atol=1e-5)
+
+
+def test_gae_segments_holes_match_padded():
+    rng = np.random.default_rng(4)
+    L = 12
+    mask = (rng.random((2, L)) > 0.3).astype(np.float32)
+    rewards = rng.normal(size=(2, L)).astype(np.float32) * mask
+    values = rng.normal(size=(2, L)).astype(np.float32) * mask
+    adv_p, _ = gae_padded(rewards, values, mask, gamma=0.95, lam=0.9)
+    seg = np.concatenate([np.zeros(L, np.int32), np.ones(L, np.int32)])
+    adv_s, _ = gae_segments(
+        rewards.reshape(-1), values.reshape(-1), seg, 0.95, 0.9,
+        loss_mask=mask.reshape(-1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(adv_s).reshape(2, L), np.asarray(adv_p), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_dual_clip_mask_counts_activations():
+    import jax.numpy as jnp
+
+    # advantage very negative + ratio huge => dual clip engages
+    logp = jnp.array([2.0, 0.0])
+    old = jnp.array([0.0, 0.0])
+    adv = jnp.array([-1.0, 1.0])
+    lm = jnp.ones(2)
+    _, st = ppo_actor_loss_fn(logp, old, adv, eps_clip=0.2, loss_mask=lm, c_clip=3.0)
+    # position 0 (adv<0, ratio=e^2): dual clip binds; position 1 does not
+    assert float(st["dual_clip_ratio"]) == 1.0
+
+
+def test_sampling_unrestricted_full_vocab():
+    """top_k=0, top_p=1 must be able to emit tokens beyond the top-64
+    window (ADVICE r1: behavior policy must match reported logprobs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.gen.sampling import sample_tokens
+
+    S, V = 64, 256
+    # near-uniform logits: window-truncated sampling could only ever emit
+    # 64 distinct tokens; full-vocab sampling covers far more
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 0.01, (S, V)).astype(np.float32))
+    seen = set()
+    for i in range(8):
+        toks, lps = sample_tokens(
+            logits,
+            jax.random.PRNGKey(i),
+            temperature=jnp.ones(S),
+            top_k=jnp.zeros(S, jnp.int32),
+            top_p=jnp.ones(S),
+        )
+        seen.update(np.asarray(toks).tolist())
+        assert np.all(np.isfinite(np.asarray(lps)))
+    assert len(seen) > 64, f"only {len(seen)} distinct tokens: still truncated"
+    # restricted slots still honour top_k
+    toks, _ = sample_tokens(
+        logits,
+        jax.random.PRNGKey(99),
+        temperature=jnp.ones(S),
+        top_k=jnp.full(S, 2, jnp.int32),
+        top_p=jnp.ones(S),
+    )
+    top2 = np.argsort(np.asarray(logits), axis=-1)[:, -2:]
+    assert all(t in top2[i] for i, t in enumerate(np.asarray(toks).tolist()))
