@@ -46,7 +46,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.models import (
     TransformerConfig,
-    forward as model_forward,
+    forward_lm as model_forward_lm,
     init_params,
     param_partition_specs,
 )
@@ -59,16 +59,17 @@ from areal_tpu.utils.data import (
     unpack_rows,
 )
 from areal_tpu.utils.datapack import round_up_to_bucket
-from areal_tpu.ops.functional import gather_logprobs
+from areal_tpu.ops.functional import lm_logprobs_entropy
 
 logger = logging.getLogger("jax_train")
 
 
-def _logp_hook(logits, mb):
+def _logp_hook(model_out, mb):
     """Default forward hook: next-token logprobs at predictor positions
     (the reference's compute_logp convention, ppo/actor.py:52)."""
     labels = jnp.roll(mb["input_ids"], -1, axis=-1)
-    return gather_logprobs(logits, labels)
+    logp, _, _ = lm_logprobs_entropy(model_out, labels, with_entropy=False)
+    return logp
 
 
 class JaxTrainEngine(TrainEngine):
@@ -91,9 +92,10 @@ class JaxTrainEngine(TrainEngine):
         self._ft_spec: Optional[FinetuneSpec] = None
         self.initialized = False
         # the jitted step functions call self._model_fn(params, cfg, ids,
-        # positions, segment_ids); value/reward engines override it to return
-        # per-token values instead of logits
-        self._model_fn = model_forward
+        # positions, segment_ids, mesh=mesh); the default returns a deferred
+        # LMOutput (chunked-head memory discipline); value/reward engines
+        # override it to return per-token values instead
+        self._model_fn = model_forward_lm
 
     # ------------------------------------------------------------------
     # setup
@@ -264,42 +266,59 @@ class JaxTrainEngine(TrainEngine):
     def _build_train_step(self, loss_fn: Callable):
         mcfg = self.model_config
         optimizer = self._optimizer
+        schedule = self._schedule
+        mesh = self.mesh
         model_fn = self._model_fn
 
-        def train_step(params, opt_state, batch, total_weight):
+        def train_step(params, opt_state, batch, total_weight, step_idx):
             def mb_loss(p, mb):
                 logits = model_fn(
-                    p, mcfg, mb["input_ids"], mb["positions"], mb["segment_ids"]
+                    p, mcfg, mb["input_ids"], mb["positions"], mb["segment_ids"],
+                    mesh=mesh,
                 )
                 loss, stats = loss_fn(logits, mb)
                 return loss / total_weight, stats
 
             grad_fn = jax.value_and_grad(mb_loss, has_aux=True)
-            # accumulate at master-weight precision: fp32 masters get fp32
-            # accumulation (reference behavior); bf16-master (memory-tight)
-            # runs avoid doubling gradient HBM
-            zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, p.dtype), params
-            )
-
-            def scan_body(carry, mb):
-                grads_acc, loss_acc = carry
-                (loss, stats), grads = grad_fn(params, mb)
-                grads_acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+            if batch["input_ids"].shape[0] == 1:
+                # single micro-batch: no accumulator buffer (one full
+                # gradient tree of HBM saved — the margin that decides the
+                # largest fitting batch on a 16G chip)
+                (loss, stats), grads = grad_fn(
+                    params, jax.tree_util.tree_map(lambda v: v[0], batch)
                 )
-                return (grads_acc, loss_acc + loss), stats
+            else:
+                # accumulate at master-weight precision: fp32 masters get
+                # fp32 accumulation (reference behavior); bf16-master
+                # (memory-tight) runs avoid doubling gradient HBM
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), params
+                )
 
-            (grads, loss), stats = jax.lax.scan(
-                scan_body, (zero_grads, jnp.zeros((), jnp.float32)), batch
-            )
-            stats = jax.tree_util.tree_map(lambda s: jnp.sum(s, axis=0), stats)
+                def scan_body(carry, mb):
+                    grads_acc, loss_acc = carry
+                    (loss, stats), grads = grad_fn(params, mb)
+                    grads_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+                    )
+                    return (grads_acc, loss_acc + loss), stats
+
+                (grads, loss), stats = jax.lax.scan(
+                    scan_body, (zero_grads, jnp.zeros((), jnp.float32)), batch
+                )
+                stats = jax.tree_util.tree_map(
+                    lambda s: jnp.sum(s, axis=0), stats
+                )
             grad_norm = optax.global_norm(grads)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
             stats = dict(stats)
             stats["grad_norm"] = grad_norm
             stats["loss"] = loss
+            # lr is evaluated inside the jitted step: an eager schedule call
+            # per step costs several device round-trips (painful on tunneled
+            # TPU runtimes where each eager dispatch is a network hop)
+            stats["lr"] = schedule(step_idx)
             return new_params, new_opt_state, stats
 
         return jax.jit(train_step, donate_argnums=(0, 1))
@@ -329,11 +348,16 @@ class JaxTrainEngine(TrainEngine):
         t0 = time.perf_counter()
         with self.mesh:
             self.params, self.opt_state, stats = step_fn(
-                self.params, self.opt_state, dev_batch, jnp.float32(total_weight)
+                self.params,
+                self.opt_state,
+                dev_batch,
+                jnp.float32(total_weight),
+                # optax evaluates the schedule at the pre-increment count
+                jnp.int32(self.step_count),
             )
-        stats = {k: float(v) for k, v in stats.items()}
-        # optax evaluated the schedule at the pre-increment count
-        stats["lr"] = float(self._schedule(self.step_count))
+        # ONE host transfer for every stat; per-scalar float() would pay a
+        # device round-trip each
+        stats = {k: float(v) for k, v in jax.device_get(stats).items()}
         self.step_count += 1
         stats["total_loss_weight"] = total_weight
         stats["step_time"] = time.perf_counter() - t0
@@ -359,6 +383,7 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._forward_cache:
 
             model_fn = self._model_fn
+            mesh = self.mesh
 
             def eval_step(params, batch):
                 def mb_loss(carry, mb):
@@ -368,6 +393,7 @@ class JaxTrainEngine(TrainEngine):
                         mb["input_ids"],
                         mb["positions"],
                         mb["segment_ids"],
+                        mesh=mesh,
                     )
                     loss, stats = loss_fn(logits, mb)
                     return carry + loss, stats
@@ -380,6 +406,7 @@ class JaxTrainEngine(TrainEngine):
             self._forward_cache[key] = jax.jit(eval_step)
         with self.mesh:
             loss, stats = self._forward_cache[key](self.params, dev_batch)
+        loss, stats = jax.device_get((loss, stats))
         out = {k: float(v) for k, v in stats.items()}
         out["loss"] = float(loss) / max(total_weight, 1e-8)
         return out
@@ -415,6 +442,7 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._forward_cache:
 
             model_fn = self._model_fn
+            mesh = self.mesh
 
             def fwd_step(params, batch):
                 logits = model_fn(
@@ -423,6 +451,7 @@ class JaxTrainEngine(TrainEngine):
                     batch["input_ids"],
                     batch["positions"],
                     batch["segment_ids"],
+                    mesh=mesh,
                 )
                 return post_hook(logits, batch)
 

@@ -21,6 +21,7 @@ stats).  TPU-first differences:
 import functools
 from typing import Any, Dict, List, Optional
 
+import jax
 import numpy as np
 
 from areal_tpu.api.config import PPOActorConfig
@@ -29,6 +30,10 @@ from areal_tpu.ops.functional import grpo_loss_fn
 from areal_tpu.ops.gae import gae_padded
 from areal_tpu.utils import logging, stats
 from areal_tpu.utils.data import Normalization, split_padded_tensor_dict_into_mb_list
+
+# jitted once per (shape, gamma, lam): eager execution would pay a device
+# round-trip per op, which dominates on tunneled TPU runtimes
+_gae_padded_jit = jax.jit(gae_padded, static_argnums=(3, 4))
 
 logger = logging.getLogger("ppo.actor")
 
@@ -77,13 +82,16 @@ class PPOActor:
         the proximal policy of the decoupled objective."""
         temp = self.config.temperature
 
-        def hook(logits, mb):
+        def hook(model_out, mb):
             import jax.numpy as jnp
 
-            from areal_tpu.ops.functional import gather_logprobs
+            from areal_tpu.ops.functional import lm_logprobs_entropy
 
             labels = jnp.roll(mb["input_ids"], -1, axis=-1)
-            return gather_logprobs(logits.astype(jnp.float32) / temp, labels)
+            logp, _, _ = lm_logprobs_entropy(
+                model_out, labels, temperature=temp, with_entropy=False
+            )
+            return logp
 
         if not hasattr(self, "_logp_hook"):
             self._logp_hook = hook
@@ -158,10 +166,10 @@ class PPOActor:
             if values is not None
             else np.zeros((B, L), np.float32)
         )
-        adv, returns = gae_padded(
+        adv, returns = _gae_padded_jit(
             tok_rewards, values, mask, cfg.discount, cfg.gae_lambda
         )
-        adv, returns = np.asarray(adv), np.asarray(returns)
+        adv, returns = jax.device_get((adv, returns))
         if self.adv_norm is not None:
             adv = self.adv_norm(adv, mask)
 

@@ -25,8 +25,8 @@ from areal_tpu.ops.functional import ppo_critic_loss_fn
 from areal_tpu.utils.data import split_padded_tensor_dict_into_mb_list
 
 
-def _value_forward(params, cfg, input_ids, positions, segment_ids):
-    hidden = forward_hidden(params, cfg, input_ids, positions, segment_ids)
+def _value_forward(params, cfg, input_ids, positions, segment_ids, mesh=None):
+    hidden = forward_hidden(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
     head = params["value_head"].astype(hidden.dtype)
     return jnp.einsum("btd,d->bt", hidden, head)
 

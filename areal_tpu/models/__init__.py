@@ -1,13 +1,17 @@
 from areal_tpu.models.model_config import TransformerConfig
 from areal_tpu.models.transformer import (
+    LMOutput,
     forward,
+    forward_lm,
     init_params,
     param_partition_specs,
 )
 
 __all__ = [
     "TransformerConfig",
+    "LMOutput",
     "forward",
+    "forward_lm",
     "init_params",
     "param_partition_specs",
 ]

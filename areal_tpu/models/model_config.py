@@ -44,6 +44,9 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # compute/activation dtype
     param_dtype: str = "float32"  # master weights
     remat: bool = True  # jax.checkpoint each layer
+    # attention implementation: "auto" picks the Pallas splash kernel on TPU
+    # when shapes allow and the naive einsum path elsewhere (ops/attention.py)
+    attn_impl: str = "auto"  # auto | splash | naive
 
     # bookkeeping
     hf_architecture: str = "LlamaForCausalLM"

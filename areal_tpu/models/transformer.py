@@ -21,14 +21,21 @@ attention, realhf/impl/model/modules/attn.py:307).  Design differences:
 """
 
 import functools
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.ops.attention import (  # noqa: F401 — re-exported for gen paths
+    make_attention_mask,
+    naive_attention as attention,
+    segment_attention,
+    splash_supported,
+)
 
 Params = Dict[str, Any]
 
@@ -66,52 +73,6 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
 
 
-def make_attention_mask(
-    segment_ids: jax.Array,
-    positions: jax.Array,
-    sliding_window: Optional[int] = None,
-) -> jax.Array:
-    """[B, T] segment ids (-1 = pad) -> bool [B, 1, T, T] mask.
-
-    Causality is by *position within the segment*, so packed layouts where
-    each sequence restarts positions at 0 are handled uniformly with padded
-    layouts (positions strictly increase inside a segment).
-    """
-    seg_q = segment_ids[:, :, None]
-    seg_k = segment_ids[:, None, :]
-    same = (seg_q == seg_k) & (seg_q >= 0)
-    pos_q = positions[:, :, None]
-    pos_k = positions[:, None, :]
-    causal = pos_k <= pos_q
-    mask = same & causal
-    if sliding_window is not None:
-        mask &= pos_k > pos_q - sliding_window
-    return mask[:, None, :, :]
-
-
-def attention(
-    q: jax.Array,  # [B, T, Hq, hd]
-    k: jax.Array,  # [B, S, Hkv, hd]
-    v: jax.Array,  # [B, S, Hkv, hd]
-    mask: jax.Array,  # bool [B, 1, T, S]
-    logit_softcap: Optional[float] = None,
-) -> jax.Array:
-    """Grouped-query attention with fp32 softmax. Returns [B, T, Hq, hd]."""
-    B, T, Hq, hd = q.shape
-    Hkv = k.shape[2]
-    group = Hq // Hkv
-    q = q.reshape(B, T, Hkv, group, hd)
-    scores = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
-    scores *= 1.0 / np.sqrt(hd)
-    if logit_softcap:
-        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
-    mask = mask[:, :, None, :, :] if mask.ndim == 4 else mask  # [B,1,1,T,S]
-    scores = jnp.where(mask, scores, -2.3819763e38)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
-    return out.reshape(B, T, Hq, hd)
-
-
 # ---------------------------------------------------------------------------
 # Layer / model forward
 # ---------------------------------------------------------------------------
@@ -119,11 +80,14 @@ def attention(
 
 def _layer_forward(
     cfg: TransformerConfig,
+    mesh: Optional[Mesh],
     lp: Params,  # this layer's params (no leading L axis)
     x: jax.Array,  # [B, T, D]
     cos: jax.Array,
     sin: jax.Array,
-    mask: jax.Array,
+    seg: jax.Array,  # [B, T] segment ids
+    pos: jax.Array,  # [B, T] positions
+    mask: Optional[jax.Array],  # [B, 1, T, T] — naive path only
 ):
     """One decoder block (cache-free; the generation paths below thread
     their own cache through the same _qkv/_mlp primitives)."""
@@ -133,7 +97,20 @@ def _layer_forward(
     q, k, v = _qkv(cfg, lp, h, dtype)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn_out = attention(q, k, v, mask, cfg.attn_logit_softcap)
+    if mask is not None:
+        attn_out = attention(q, k, v, mask, cfg.attn_logit_softcap)
+    else:
+        attn_out = segment_attention(
+            q,
+            k,
+            v,
+            seg,
+            pos,
+            sliding_window=cfg.sliding_window,
+            logit_softcap=cfg.attn_logit_softcap,
+            impl="splash",
+            mesh=mesh,
+        )
     attn_out = attn_out.reshape(B, T, cfg.q_size)
     x = x + jnp.einsum("bth,hd->btd", attn_out, lp["attn"]["wo"].astype(dtype))
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
@@ -146,20 +123,32 @@ def forward_hidden(
     input_ids: jax.Array,  # int32 [B, T]
     positions: jax.Array,  # int32 [B, T]
     segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """Backbone forward -> final-norm hidden states [B, T, D] (for value /
     reward heads, the role of the reference's critic models)."""
     dtype = jnp.dtype(cfg.dtype)
     x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    mask = make_attention_mask(segment_ids, positions, cfg.sliding_window)
 
-    layer_fn = functools.partial(_layer_forward, cfg)
+    B, T = input_ids.shape
+    sp = mesh.shape["sp"] if mesh is not None else 1
+    use_splash = cfg.attn_impl != "naive" and splash_supported(
+        T, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, sp=sp
+    )
+    # the splash path never materialises a mask; naive builds [B,1,T,T] once
+    mask = (
+        None
+        if use_splash
+        else make_attention_mask(segment_ids, positions, cfg.sliding_window)
+    )
+
+    layer_fn = functools.partial(_layer_forward, cfg, mesh)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
     def scan_body(x, lp):
-        x, _ = layer_fn(lp, x, cos, sin, mask)
+        x, _ = layer_fn(lp, x, cos, sin, segment_ids, positions, mask)
         return x, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
@@ -172,15 +161,46 @@ def forward(
     input_ids: jax.Array,  # int32 [B, T]
     positions: jax.Array,  # int32 [B, T]
     segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """Full forward -> logits [B, T, V] (in cfg.dtype; softmax-sensitive
     consumers should upcast)."""
     dtype = jnp.dtype(cfg.dtype)
-    x = forward_hidden(params, cfg, input_ids, positions, segment_ids)
+    x = forward_hidden(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
     return jnp.einsum("btd,dv->btv", x, head.astype(dtype))
+
+
+class LMOutput(NamedTuple):
+    """Deferred language-model head: final-norm hidden states + head matrix.
+
+    Train-path losses consume this instead of materialised logits so the
+    [tokens, vocab] matrix (2.4 GB bf16 / 4.9 GB fp32 at 8k tokens on a 151k
+    vocab — the round-1 OOM wall) only ever exists one chunk at a time inside
+    `ops.functional.lm_logprobs_entropy`'s rematerialised scan.
+    """
+
+    hidden: jax.Array  # [B, T, D] in compute dtype
+    head: jax.Array  # [D, V] in compute dtype
+
+
+def forward_lm(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,
+    positions: jax.Array,
+    segment_ids: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> LMOutput:
+    """Backbone forward with a *deferred* LM head (see LMOutput)."""
+    dtype = jnp.dtype(cfg.dtype)
+    x = forward_hidden(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    return LMOutput(hidden=x, head=head.astype(dtype))
 
 
 def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax.Array]):

@@ -1,0 +1,276 @@
+"""Segment-masked attention with a Pallas splash-attention fast path.
+
+Role counterpart of the reference's flash-attn varlen attention
+(realhf/impl/model/modules/attn.py:307: flash_attn_varlen_func over packed
+cu_seqlens batches) and of the SDPA fallback in lite's HF models.  TPU-first
+design differences:
+
+- Packed variable-length batches are expressed with **segment ids** (-1 =
+  padding), not cu_seqlens; causality is by buffer index, which equals
+  per-segment position order because packed segments are contiguous.
+- The fast path is the TPU splash-attention Pallas kernel
+  (`jax.experimental.pallas.ops.tpu.splash_attention`): blockwise online
+  softmax, never materialises the [T, S] score matrix, and skips fully-masked
+  key blocks — the property that makes 32k-context training feasible where
+  the naive einsum path's O(T^2) memory is hopeless (VERDICT.md missing #4).
+- GQA runs the MQA kernel vmapped over kv heads (q grouped per kv head).
+- Under a `jax.sharding.Mesh` the kernel is wrapped in `shard_map`: batch
+  rows over (dp, fsdp), kv heads over tp, and the **query sequence over sp**
+  (the kernel is built with q_seq_shards so its block schedule stays
+  causal-load-balanced).  K/V stay whole along the sequence — GSPMD inserts
+  the all-gather — which is the DeepSpeed-Ulysses memory regime the
+  reference gets from areal/utils/ulysses.py.
+- The naive einsum path remains for CPU tests, odd head dims, and as the
+  numerical reference; both paths share one public entry point.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+try:  # TPU-only kernels; import lazily guarded so CPU tests work
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+    )
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_mask as _sm,
+    )
+
+    HAVE_SPLASH = True
+except Exception:  # pragma: no cover
+    HAVE_SPLASH = False
+
+MASK_VALUE = -2.3819763e38
+
+# Tests flip this to run the Pallas kernels in interpret mode on the CPU
+# mesh — the only way to exercise the sharded splash path without 8 chips.
+INTERPRET = False
+
+
+# ---------------------------------------------------------------------------
+# Naive reference path (CPU fallback + numerics oracle)
+# ---------------------------------------------------------------------------
+
+
+def make_attention_mask(
+    segment_ids: jax.Array,
+    positions: jax.Array,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """[B, T] segment ids (-1 = pad) -> bool [B, 1, T, T] mask.
+
+    Causality is by *position within the segment*, so packed layouts where
+    each sequence restarts positions at 0 are handled uniformly with padded
+    layouts (positions strictly increase inside a segment).
+    """
+    seg_q = segment_ids[:, :, None]
+    seg_k = segment_ids[:, None, :]
+    same = (seg_q == seg_k) & (seg_q >= 0)
+    pos_q = positions[:, :, None]
+    pos_k = positions[:, None, :]
+    causal = pos_k <= pos_q
+    mask = same & causal
+    if sliding_window is not None:
+        mask &= pos_k > pos_q - sliding_window
+    return mask[:, None, :, :]
+
+
+def naive_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, S, Hkv, hd]
+    v: jax.Array,  # [B, S, Hkv, hd]
+    mask: jax.Array,  # bool [B, 1, T, S]
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Grouped-query attention with fp32 softmax. Returns [B, T, Hq, hd]."""
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    q = q.reshape(B, T, Hkv, group, hd)
+    scores = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
+    scores *= 1.0 / np.sqrt(hd)
+    if logit_softcap:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    mask = mask[:, :, None, :, :] if mask.ndim == 4 else mask  # [B,1,1,T,S]
+    scores = jnp.where(mask, scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(B, T, Hq, hd)
+
+
+# ---------------------------------------------------------------------------
+# Splash kernel construction
+# ---------------------------------------------------------------------------
+
+
+def splash_supported(T: int, Hq: int, Hkv: int, hd: int, sp: int = 1) -> bool:
+    """Shapes the kernel handles well; everything else takes the naive path.
+    `sp` = sequence shards: each shard's query extent must stay blockable."""
+    return (
+        HAVE_SPLASH
+        and (jax.default_backend() == "tpu" or INTERPRET)
+        and T >= 256
+        and T % (128 * sp) == 0
+        and hd % 128 == 0
+        and Hq % Hkv == 0
+    )
+
+
+def _mask_for(T: int, sliding_window: Optional[int]) -> "_sm.Mask":
+    if sliding_window is not None:
+        # causal left-window: q - w < k <= q
+        return _sm.LocalMask((T, T), (sliding_window - 1, 0), 0)
+    return _sm.CausalMask((T, T))
+
+
+@functools.lru_cache(maxsize=64)
+def _make_kernel(
+    T: int,
+    group: int,
+    sliding_window: Optional[int],
+    logit_softcap: Optional[float],
+    q_seq_shards: int,
+    interpret: bool = False,
+):
+    """Build (and cache — mask-info preprocessing is host-side numpy) the
+    MQA splash kernel for one (seq-len, q-group) shape."""
+    mask = _sm.MultiHeadMask([_mask_for(T, sliding_window) for _ in range(group)])
+    # block sizes must divide the per-shard query extent
+    block = min(512, T // q_seq_shards)
+    block_sizes = _sk.BlockSizes(
+        block_q=block,
+        block_kv=block,
+        block_kv_compute=block,
+        block_q_dkv=block,
+        block_kv_dkv=block,
+        block_kv_dkv_compute=block,
+        block_q_dq=block,
+        block_kv_dq=block,
+    )
+    # make_* calls jnp.array on the host-side mask info; when the kernel is
+    # first built during a jit trace (lru_cache defers to first use) that
+    # would capture per-trace tracers in the cached kernel and leak them
+    # into later traces — force concrete compile-time values instead
+    with jax.ensure_compile_time_eval():
+        return _sk.make_splash_mqa_single_device(
+            mask=mask,
+            block_sizes=block_sizes,
+            attn_logits_soft_cap=logit_softcap,
+            q_seq_shards=q_seq_shards,
+            interpret=interpret,
+        )
+
+
+def _splash_call(kernel, q, k, v, segment_ids, group: int):
+    """q [B, T, Hq, hd], k/v [B, T, Hkv, hd], segment_ids [B, T] ->
+    [B, T, Hq, hd].  vmap over batch and kv heads of the MQA kernel."""
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    qs = (q * float(1.0 / np.sqrt(hd))).transpose(0, 2, 1, 3)  # [B, Hq, T, hd]
+    qs = qs.reshape(B, Hkv, group, T, hd)
+    ks = k.transpose(0, 2, 1, 3)  # [B, Hkv, T, hd]
+    vs = v.transpose(0, 2, 1, 3)
+
+    def per_row(qr, kr, vr, seg):
+        sids = _sk.SegmentIds(q=seg, kv=seg)
+        return jax.vmap(kernel, in_axes=(0, 0, 0, None))(qr, kr, vr, sids)
+
+    out = jax.vmap(per_row)(qs, ks, vs, segment_ids)  # [B, Hkv, group, T, hd]
+    return out.reshape(B, Hq, T, hd).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Public entry point
+# ---------------------------------------------------------------------------
+
+
+def segment_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, T, Hkv, hd]
+    v: jax.Array,  # [B, T, Hkv, hd]
+    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    positions: jax.Array,  # int32 [B, T] (per-segment positions)
+    sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+    impl: str = "auto",  # auto | splash | naive
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Causal segment-masked self-attention over packed/padded rows.
+
+    Requires packed segments to be contiguous with per-segment positions
+    increasing by 1 per buffer slot (the layout `pack_into_rows` emits), so
+    buffer-index causality equals position causality — the invariant that
+    lets the splash kernel use its lazy causal mask instead of a
+    materialised one.
+    """
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    if impl == "auto":
+        sp = mesh.shape["sp"] if mesh is not None else 1
+        impl = "splash" if splash_supported(T, Hq, Hkv, hd, sp=sp) else "naive"
+    if impl == "naive":
+        mask = make_attention_mask(segment_ids, positions, sliding_window)
+        return naive_attention(q, k, v, mask, logit_softcap)
+
+    group = Hq // Hkv
+    segment_ids = segment_ids.astype(jnp.int32)
+    if mesh is None or all(mesh.shape[a] == 1 for a in ("dp", "fsdp", "sp", "tp")):
+        kernel = _make_kernel(
+            T, group, sliding_window, logit_softcap, 1, interpret=INTERPRET
+        )
+        return _splash_call(kernel, q, k, v, segment_ids, group)
+    return _sharded_splash(
+        q, k, v, segment_ids, mesh, group, sliding_window, logit_softcap
+    )
+
+
+def _sharded_splash(
+    q, k, v, segment_ids, mesh: Mesh, group, sliding_window, logit_softcap
+):
+    """shard_map-wrapped splash: batch over (dp, fsdp), kv heads over tp,
+    query sequence over sp; K/V whole along sequence (Ulysses memory
+    regime).  The kernel is built with q_seq_shards and its mask-info arrays
+    are sharded with `manual_sharding_spec` so each sp shard runs only its
+    causally-needed blocks."""
+    sp = mesh.shape["sp"]
+    T = q.shape[1]
+    kernel = _make_kernel(
+        T, group, sliding_window, logit_softcap, sp, interpret=INTERPRET
+    )
+    kernel_spec = kernel.manual_sharding_spec(
+        NamedSharding(mesh, P(None, "sp"))  # (head, q_seq) mask-info layout
+    )
+    batch = ("dp", "fsdp")
+
+    def body(kern, qs, ks, vs, seg_q, seg_kv):
+        def per_row(qr, kr, vr, sq, skv):
+            sids = _sk.SegmentIds(q=sq, kv=skv)
+            return jax.vmap(kern, in_axes=(0, 0, 0, None))(qr, kr, vr, sids)
+
+        return jax.vmap(per_row)(qs, ks, vs, seg_q, seg_kv)
+
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    qs = (q * float(1.0 / np.sqrt(hd))).transpose(0, 2, 1, 3).reshape(B, Hkv, group, T, hd)
+    ks = k.transpose(0, 2, 1, 3)
+    vs = v.transpose(0, 2, 1, 3)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            kernel_spec,
+            P(batch, "tp", None, "sp", None),  # q: [B, Hkv, group, T, hd]
+            P(batch, "tp", None, None),  # k: [B, Hkv, S, hd] — S whole
+            P(batch, "tp", None, None),
+            P(batch, "sp"),  # q segment ids
+            P(batch, None),  # kv segment ids — whole
+        ),
+        out_specs=P(batch, "tp", None, "sp", None),
+        check_vma=False,
+    )(kernel, qs, ks, vs, segment_ids, segment_ids)
+    return out.reshape(B, Hq, T, hd).transpose(0, 2, 1, 3)

@@ -66,6 +66,70 @@ def gather_logprobs_entropy(
     return picked - logz, entropy
 
 
+def _chunk_len(n: int, target: int) -> int:
+    c = min(n, target)
+    while n % c:
+        c -= 1
+    return c
+
+
+def lm_logprobs_entropy(
+    out,  # LMOutput (deferred head) or materialised logits [..., V]
+    labels: jax.Array,  # int [...]
+    temperature: float = 1.0,
+    chunk: int = 1024,
+    with_entropy: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(logprobs, entropy, argmax-correct) of `labels`, fp32 numerics.
+
+    With an `LMOutput`, the head matmul + log-softmax run in a rematerialised
+    `lax.scan` over token chunks: peak extra memory is one [chunk, V] fp32
+    block instead of the full [tokens, V] logits (forward AND backward — the
+    scan transpose recomputes each chunk's logits and accumulates the head's
+    cotangent across chunks).  This is the TPU-side equivalent of the
+    reference's vocab-parallel cross-entropy memory discipline
+    (realhf .../tensor_parallel/modules.py:1180 vocab_parallel_cross_entropy):
+    same goal — never hold full fp32 logits — achieved by chunking time
+    instead of sharding vocab.
+    """
+    from areal_tpu.models.transformer import LMOutput
+
+    inv_t = float(1.0 / temperature)
+    if not isinstance(out, LMOutput):
+        logits = out.astype(jnp.float32) * inv_t
+        logp, ent = gather_logprobs_entropy(logits, labels)
+        corr = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        return logp, ent, corr
+
+    shape = labels.shape
+    D = out.hidden.shape[-1]
+    h = out.hidden.reshape(-1, D)
+    lab = labels.reshape(-1)
+    N = h.shape[0]
+    c = _chunk_len(N, chunk)
+    hs = h.reshape(N // c, c, D)
+    ls = lab.reshape(N // c, c)
+    head = out.head
+
+    @jax.checkpoint
+    def one_chunk(carry, xs):
+        hc, lc = xs
+        logits = (hc @ head).astype(jnp.float32) * inv_t
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+        if with_entropy:
+            p = jax.nn.softmax(logits, axis=-1)
+            ent = logz - jnp.sum(p * logits, axis=-1)
+            corr = (jnp.argmax(logits, axis=-1) == lc).astype(jnp.float32)
+        else:
+            ent = jnp.zeros_like(logz)
+            corr = jnp.zeros_like(logz)
+        return carry, (picked - logz, ent, corr)
+
+    _, (lp, ent, corr) = jax.lax.scan(one_chunk, (), (hs, ls))
+    return lp.reshape(shape), ent.reshape(shape), corr.reshape(shape)
+
+
 def kl_estimate(
     logp: jax.Array, ref_logp: jax.Array, kind: str = "k1", clip: float = 20.0
 ) -> jax.Array:
@@ -153,7 +217,7 @@ def ppo_actor_loss_fn(
 
 
 def grpo_loss_fn(
-    logits: jax.Array,  # [T, V] packed
+    model_out,  # LMOutput or [T, V] packed logits
     batch: Dict[str, jax.Array],
     eps_clip: float,
     c_clip: Optional[float] = None,
@@ -171,8 +235,9 @@ def grpo_loss_fn(
     """
     labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     loss_mask = batch["loss_mask"].astype(jnp.float32)
-    logits = logits.astype(jnp.float32) / temperature
-    logprobs, entropy = gather_logprobs_entropy(logits, labels)
+    logprobs, entropy, _ = lm_logprobs_entropy(
+        model_out, labels, temperature=temperature
+    )
     old_logp = batch["logprobs"]
     prox = batch.get("prox_logp") if use_decoupled_loss else None
     loss, stats = ppo_actor_loss_fn(
@@ -224,19 +289,18 @@ def ppo_critic_loss_fn(
 
 
 def sft_loss_fn(
-    logits: jax.Array, batch: Dict[str, jax.Array]
+    model_out, batch: Dict[str, jax.Array]
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Token cross-entropy over next-token targets, masked sum
     (reference: areal/engine/sft/lm_engine.py)."""
     labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     mask = batch["loss_mask"].astype(jnp.float32)
-    logprobs = gather_logprobs(logits, labels)
+    logprobs, _, correct = lm_logprobs_entropy(model_out, labels)
     loss = -jnp.sum(logprobs * mask)
-    seq_correct = jnp.sum((jnp.argmax(logits, axis=-1) == labels) * mask)
     return loss, {
         "loss_sum": loss,
         "n_valid_tokens": jnp.sum(mask),
-        "correct_tokens": seq_correct,
+        "correct_tokens": jnp.sum(correct * mask),
     }
 
 

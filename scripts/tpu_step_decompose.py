@@ -1,0 +1,118 @@
+"""Decompose 1.5B train-step time: fwd / fwd+bwd / full step / optimizer.
+
+Identifies where the fixed per-step overhead lives (scatter-add embedding
+grads? optimizer? loss head?).
+"""
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, "/root/repo")
+
+from areal_tpu.models import forward_lm, init_params
+from areal_tpu.models.model_config import qwen25_1p5b
+from areal_tpu.ops.functional import grpo_loss_fn
+
+
+def timeit(fn, *args, n=3, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    cfg = qwen25_1p5b().replace(
+        dtype="bfloat16", param_dtype="bfloat16", remat=True
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    R, L = 8, 2048
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (R, L)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (R, L)).copy()
+    seg = np.zeros((R, L), np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids),
+        "positions": jnp.asarray(pos),
+        "segment_ids": jnp.asarray(seg),
+        "loss_mask": jnp.ones((R, L), jnp.float32),
+        "logprobs": jnp.asarray(rng.normal(-1, 0.1, (R, L)), jnp.float32),
+        "advantages": jnp.asarray(rng.normal(size=(R, L)), jnp.float32),
+    }
+    batch["prox_logp"] = batch["logprobs"]
+    tokens = R * L
+
+    def loss(p, b):
+        out = forward_lm(p, cfg, b["input_ids"], b["positions"], b["segment_ids"])
+        l, _ = grpo_loss_fn(out, b, eps_clip=0.2)
+        return l / tokens
+
+    fwd = jax.jit(loss)
+    t = timeit(fwd, params, batch)
+    print(f"fwd only:          {t * 1e3:7.0f} ms  {tokens / t:8,.0f} tok/s")
+
+    vg = jax.jit(lambda p, b: jax.grad(loss)(p, b))
+    t = timeit(vg, params, batch)
+    print(f"fwd+bwd:           {t * 1e3:7.0f} ms  {tokens / t:8,.0f} tok/s")
+
+    opt = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(1e-5, b1=0.9, b2=0.95, eps=1e-8, weight_decay=0.01),
+    )
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def full(p, s, b):
+        g = jax.grad(loss)(p, b)
+        u, s2 = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    for _ in range(2):
+        params, opt_state = full(params, opt_state, batch)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        params, opt_state = full(params, opt_state, batch)
+    jax.block_until_ready(params)
+    t = (time.perf_counter() - t0) / 3
+    print(f"fwd+bwd+opt:       {t * 1e3:7.0f} ms  {tokens / t:8,.0f} tok/s")
+
+    # no-remat variant
+    cfg2 = cfg.replace(remat=False)
+
+    def loss2(p, b):
+        out = forward_lm(p, cfg2, b["input_ids"], b["positions"], b["segment_ids"])
+        l, _ = grpo_loss_fn(out, b, eps_clip=0.2)
+        return l / tokens
+
+    try:
+        vg2 = jax.jit(lambda p, b: jax.grad(loss2)(p, b))
+        t = timeit(vg2, params, batch)
+        print(f"fwd+bwd noremat:   {t * 1e3:7.0f} ms  {tokens / t:8,.0f} tok/s")
+    except Exception as e:
+        print(f"noremat: FAIL {'OOM' if 'RESOURCE_EXHAUSTED' in str(e) else str(e)[:120]}")
+
+    # head-only cost: logits loss on detached hidden
+    def loss_head_only(p, b):
+        out = forward_lm(p, cfg, b["input_ids"], b["positions"], b["segment_ids"])
+        out = jax.tree_util.tree_map(jax.lax.stop_gradient, out)
+        l, _ = grpo_loss_fn(out, b, eps_clip=0.2)
+        return l / tokens
+
+    vg3 = jax.jit(lambda p, b: jax.grad(loss_head_only)(p, b))
+    t = timeit(vg3, params, batch)
+    print(f"fwd+bwd(head-only):{t * 1e3:7.0f} ms  {tokens / t:8,.0f} tok/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Real-TPU probe: 1.5B GRPO train-step throughput vs batch size / ctx.
+
+Finds the HBM-filling workload for bench.py and prints tokens/sec + step
+time + achieved TFLOP/s per configuration.
+"""
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    NormConfig,
+    OptimizerConfig,
+    PPOActorConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.engine.ppo import JaxPPOActor
+from areal_tpu.models.model_config import qwen25_1p5b
+
+
+def make_batch(rng, n_rows, row_len, vocab, seqs_per_row=2):
+    seq_len = row_len // seqs_per_row
+    B = n_rows * seqs_per_row
+    ids = rng.integers(0, vocab, (B, seq_len)).astype(np.int32)
+    mask = np.ones((B, seq_len), bool)
+    prompt = seq_len // 4
+    loss_mask = np.zeros((B, seq_len), np.float32)
+    loss_mask[:, prompt:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": mask,
+        "loss_mask": loss_mask,
+        "logprobs": rng.normal(-1.0, 0.1, (B, seq_len)).astype(np.float32),
+        "rewards": rng.integers(0, 2, B).astype(np.float32),
+        "versions": np.zeros((B, seq_len), np.int32),
+    }
+
+
+def run(n_rows, row_len, n_mbs, attn_impl="auto"):
+    model_cfg = qwen25_1p5b().replace(attn_impl=attn_impl)
+    cfg = PPOActorConfig(
+        experiment_name="bench",
+        trial_name="bench",
+        init_from_scratch=True,
+        dtype="bfloat16",
+        param_dtype="bfloat16",
+        gradient_checkpointing=True,
+        mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(n_mbs=n_mbs),
+        optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
+        pack_length_quantum=row_len,
+        max_pack_length=row_len,
+        group_size=2,
+        ppo_n_minibatches=1,
+        use_decoupled_loss=True,
+        adv_norm=NormConfig(mean_level="group", std_level="group", group_size=2),
+    )
+    actor = JaxPPOActor(cfg, model_config=model_cfg)
+    actor.initialize(ft_spec=FinetuneSpec(1, 1024, 8))
+    rng = np.random.default_rng(0)
+    batch = make_batch(rng, n_rows, row_len, model_cfg.vocab_size)
+    batch["prox_logp"] = batch["logprobs"].copy()
+    actor.compute_advantages(batch)
+    tokens = int(batch["attention_mask"].sum())
+    for _ in range(4):
+        actor.ppo_update(batch)
+    jax.block_until_ready(actor.params)
+    t0 = time.perf_counter()
+    N = 3
+    for _ in range(N):
+        actor.ppo_update(batch)
+    jax.block_until_ready(actor.params)
+    dt = (time.perf_counter() - t0) / N
+    tps = tokens / dt
+    # 6*P FLOPs/token (fwd+bwd) + remat refwd (2*P) + attention
+    P = 1.54e9
+    flops = tokens * 6 * P
+    print(
+        f"rows={n_rows} len={row_len} mbs={n_mbs} impl={attn_impl}: "
+        f"{tps:,.0f} tok/s  step={dt * 1e3:.0f} ms  "
+        f"model-flops {flops / dt / 1e12:.1f} TF/s"
+    )
+    actor.destroy()
+    return tps
+
+
+if __name__ == "__main__":
+    for args in [
+        (12, 2048, 1),
+        (16, 2048, 1),
+    ]:
+        try:
+            run(*args)
+        except Exception as e:
+            msg = str(e)
+            print(f"{args}: FAIL {'OOM' if 'RESOURCE_EXHAUSTED' in msg else msg[:200]}")

@@ -1,0 +1,115 @@
+"""Splash-attention parity vs the naive segment-masked reference.
+
+Runs the Pallas kernels in interpret mode on the virtual 8-device CPU mesh
+(tests can't see real chips; scripts/tpu_splash_parity.py is the
+on-hardware twin).  Covers the packed-segment mask semantics, GQA grouping,
+sliding windows, gradients, and the shard_map path with a sequence-sharded
+query (the Ulysses-regime long-context configuration, VERDICT.md #1/#5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.ops import attention as attn_mod
+from areal_tpu.ops.attention import (
+    make_attention_mask,
+    naive_attention,
+    segment_attention,
+)
+from areal_tpu.parallel import build_mesh
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    attn_mod.INTERPRET = True
+    yield
+    attn_mod.INTERPRET = False
+
+
+def _packed_inputs(rng, B, T, Hq, Hkv, hd, n_segs=3):
+    q = jnp.asarray(rng.normal(size=(B, T, Hq, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, Hkv, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, Hkv, hd)), jnp.float32)
+    seg = np.full((B, T), -1, np.int32)
+    pos = np.zeros((B, T), np.int32)
+    for b in range(B):
+        bounds = sorted(rng.choice(np.arange(32, T - 32), n_segs - 1, replace=False))
+        start = 0
+        for s, end in enumerate(list(bounds) + [T - 16]):  # leave tail padding
+            seg[b, start:end] = s
+            pos[b, start:end] = np.arange(end - start)
+            start = end
+    return q, k, v, jnp.asarray(seg), jnp.asarray(pos)
+
+
+def _naive(q, k, v, seg, pos, window=None, softcap=None):
+    mask = make_attention_mask(seg, pos, window)
+    return naive_attention(q, k, v, mask, softcap)
+
+
+def test_splash_matches_naive_packed_segments():
+    rng = np.random.default_rng(0)
+    q, k, v, seg, pos = _packed_inputs(rng, B=2, T=256, Hq=4, Hkv=2, hd=128)
+    out = segment_attention(q, k, v, seg, pos, impl="splash")
+    ref = _naive(q, k, v, seg, pos)
+    valid = np.asarray(seg) >= 0
+    err = np.abs(np.asarray(out) - np.asarray(ref))[valid].max()
+    assert err < 1e-4
+
+
+def test_splash_sliding_window():
+    rng = np.random.default_rng(1)
+    q, k, v, seg, pos = _packed_inputs(rng, B=1, T=256, Hq=2, Hkv=1, hd=128, n_segs=2)
+    out = segment_attention(q, k, v, seg, pos, sliding_window=64, impl="splash")
+    ref = _naive(q, k, v, seg, pos, window=64)
+    valid = np.asarray(seg) >= 0
+    err = np.abs(np.asarray(out) - np.asarray(ref))[valid].max()
+    assert err < 1e-4
+
+
+def test_splash_gradients_match():
+    rng = np.random.default_rng(2)
+    q, k, v, seg, pos = _packed_inputs(rng, B=1, T=256, Hq=4, Hkv=2, hd=128)
+    w = jnp.asarray((np.asarray(seg) >= 0)[..., None, None], jnp.float32)
+
+    def loss(impl):
+        def f(q, k, v):
+            o = segment_attention(q, k, v, seg, pos, impl=impl)
+            return ((o * w) ** 2).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    gs = loss("splash")
+    gn = loss("naive")
+    for a, b in zip(gs, gn):
+        denom = np.abs(np.asarray(b)).max() + 1e-9
+        assert np.abs(np.asarray(a) - np.asarray(b)).max() / denom < 1e-3
+
+
+def test_sharded_splash_matches_naive():
+    """dp2 x sp2 x tp2 mesh: q-sequence sharded, kv whole, kv heads over tp."""
+    mesh = build_mesh(dp=2, fsdp=1, sp=2, tp=2)
+    rng = np.random.default_rng(3)
+    q, k, v, seg, pos = _packed_inputs(rng, B=4, T=256, Hq=4, Hkv=2, hd=128)
+
+    @jax.jit
+    def sharded(q, k, v, seg, pos):
+        return segment_attention(q, k, v, seg, pos, impl="splash", mesh=mesh)
+
+    with mesh:
+        out = sharded(q, k, v, seg, pos)
+    ref = _naive(q, k, v, seg, pos)
+    valid = np.asarray(seg) >= 0
+    err = np.abs(np.asarray(out) - np.asarray(ref))[valid].max()
+    assert err < 1e-4
+
+
+def test_auto_impl_cpu_is_naive():
+    attn_mod.INTERPRET = False
+    rng = np.random.default_rng(4)
+    q, k, v, seg, pos = _packed_inputs(rng, B=1, T=256, Hq=2, Hkv=2, hd=128)
+    out = segment_attention(q, k, v, seg, pos, impl="auto")
+    ref = _naive(q, k, v, seg, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6)

@@ -271,3 +271,65 @@ def test_sampling_unrestricted_full_vocab():
     )
     top2 = np.argsort(np.asarray(logits), axis=-1)[:, -2:]
     assert all(t in top2[i] for i, t in enumerate(np.asarray(toks).tolist()))
+
+
+def test_chunked_lm_head_matches_dense():
+    """LMOutput chunked scan == dense logits path, values and gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.models.transformer import LMOutput
+    from areal_tpu.ops.functional import lm_logprobs_entropy
+
+    rng = np.random.default_rng(5)
+    B, T, D, V = 2, 12, 16, 37
+    hidden = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    head = jnp.asarray(rng.normal(size=(D, V)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, (B, T)))
+
+    logits = hidden @ head
+    lp_d, ent_d, corr_d = lm_logprobs_entropy(logits, labels, temperature=0.7)
+    lp_c, ent_c, corr_c = lm_logprobs_entropy(
+        LMOutput(hidden, head), labels, temperature=0.7, chunk=8
+    )
+    np.testing.assert_allclose(np.asarray(lp_c), np.asarray(lp_d), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ent_c), np.asarray(ent_d), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(corr_c), np.asarray(corr_d))
+
+    def loss_dense(hidden, head):
+        lp, ent, _ = lm_logprobs_entropy(hidden @ head, labels)
+        return (lp + 0.1 * ent).sum()
+
+    def loss_chunk(hidden, head):
+        lp, ent, _ = lm_logprobs_entropy(LMOutput(hidden, head), labels, chunk=8)
+        return (lp + 0.1 * ent).sum()
+
+    gd = jax.grad(loss_dense, argnums=(0, 1))(hidden, head)
+    gc = jax.grad(loss_chunk, argnums=(0, 1))(hidden, head)
+    for a, b in zip(gc, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_grpo_loss_accepts_lm_output():
+    import jax.numpy as jnp
+
+    from areal_tpu.models.transformer import LMOutput
+    from areal_tpu.ops.functional import grpo_loss_fn
+
+    rng = np.random.default_rng(6)
+    T, D, V = 16, 8, 23
+    hidden = jnp.asarray(rng.normal(size=(1, T, D)), jnp.float32)
+    head = jnp.asarray(rng.normal(size=(D, V)), jnp.float32)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, V, (1, T))),
+        "loss_mask": jnp.asarray((rng.random((1, T)) > 0.3).astype(np.float32)),
+        "logprobs": jnp.asarray(rng.normal(-1.0, 0.2, (1, T)).astype(np.float32)),
+        "advantages": jnp.asarray(rng.normal(size=(1, T)).astype(np.float32)),
+    }
+    batch["prox_logp"] = batch["logprobs"]
+    loss_d, stats_d = grpo_loss_fn(hidden @ head, batch, eps_clip=0.2)
+    loss_c, stats_c = grpo_loss_fn(LMOutput(hidden, head), batch, eps_clip=0.2)
+    np.testing.assert_allclose(float(loss_c), float(loss_d), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(stats_c["entropy"]), float(stats_d["entropy"]), rtol=1e-5
+    )
