@@ -369,6 +369,10 @@ class RWConfig(BaseExperimentConfig):
 @dataclass
 class GRPOConfig(BaseExperimentConfig):
     async_training: bool = True
+    # trainer -> inference weight sync: "disk" (shared-fs snapshot, the
+    # simple correct default) or "transfer" (HTTP chunk streaming straight
+    # into server memory — no shared filesystem, lower latency at scale)
+    weight_update_mode: str = "disk"
     gconfig: GenerationHyperparameters = field(
         default_factory=GenerationHyperparameters
     )

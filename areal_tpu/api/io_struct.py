@@ -158,9 +158,19 @@ class WeightUpdateMeta:
 
     @classmethod
     def from_transfer(
-        cls, alloc_mode: Optional["AllocationMode"] = None, chunk_mb: int = 256
+        cls,
+        experiment_name: str = "",
+        trial_name: str = "",
+        alloc_mode: Optional["AllocationMode"] = None,
+        chunk_mb: int = 256,
     ) -> "WeightUpdateMeta":
-        return cls(type="transfer", alloc_mode=alloc_mode, chunk_mb=chunk_mb)
+        return cls(
+            type="transfer",
+            alloc_mode=alloc_mode,
+            chunk_mb=chunk_mb,
+            experiment_name=experiment_name,
+            trial_name=trial_name,
+        )
 
 
 @dataclass

@@ -1,0 +1,81 @@
+"""DP-head rollout coordination for multi-process training.
+
+Behavioral counterpart of the reference's `DistRolloutCoordinator`
+(areal/core/dist_rollout.py:93): in a multi-process run only the data-parallel
+head talks to the inference servers (one client, one staleness gate — N
+clients would each admit max_concurrent_rollouts and overshoot the global
+staleness budget); every other process receives the assembled batch and
+contributes its shard of the global device batch.
+
+TPU-first differences from the reference:
+- The reference redistributes *slices* to each dp rank over NCCL
+  (dist_rollout.py:99-146 FFD split + broadcast to the tp/sp subgroup).
+  Here the whole host batch is broadcast (parallel/distributed.py
+  broadcast_pytree, two device collectives) and sharding happens when the
+  engine builds the global jax.Array — GSPMD owns placement, so host-side
+  slicing plans are unnecessary; each process materialises only the shards
+  it owns.
+- No process groups to pick: the broadcast rides the same global runtime
+  the train step uses.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+from areal_tpu.parallel import distributed
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("dist_rollout")
+
+
+class DistRolloutCoordinator:
+    """Wraps an InferenceEngine-like rollout client so that only the head
+    process drives it; results are broadcast to every process."""
+
+    def __init__(self, rollout_engine):
+        self.rollout = rollout_engine
+
+    @property
+    def is_head(self) -> bool:
+        return distributed.is_head()
+
+    def rollout_batch(
+        self,
+        data: List[Dict[str, Any]],
+        workflow=None,
+        workflow_builder: Optional[Callable] = None,
+        should_accept: Optional[Callable] = None,
+    ) -> Dict[str, Any]:
+        batch = None
+        if self.is_head:
+            batch = self.rollout.rollout_batch(
+                data,
+                workflow=workflow,
+                workflow_builder=workflow_builder,
+                should_accept=should_accept,
+            )
+        return distributed.broadcast_pytree(batch)
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow=None,
+        workflow_builder: Optional[Callable] = None,
+        should_accept: Optional[Callable] = None,
+    ) -> Dict[str, Any]:
+        batch = None
+        if self.is_head:
+            batch = self.rollout.prepare_batch(
+                dataloader,
+                workflow=workflow,
+                workflow_builder=workflow_builder,
+                should_accept=should_accept,
+            )
+        return distributed.broadcast_pytree(batch)
+
+    def pause(self):
+        if self.is_head:
+            self.rollout.pause()
+
+    def resume(self):
+        if self.is_head:
+            self.rollout.resume()

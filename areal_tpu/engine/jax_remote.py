@@ -73,10 +73,12 @@ class JaxBackend:
                     )
                 ]
             )
-        raise NotImplementedError(
-            f"weight update type {meta.type!r} is pushed chunk-wise by the "
-            "trainer (see JaxTrainEngine.update_weights), not via this backend"
-        )
+        if meta.type == "transfer":
+            # the trainer already streamed + committed the weights over
+            # /update_weights_chunk (JaxTrainEngine._update_weights_transfer);
+            # nothing for the client to send
+            return WeightUpdateRequests(requests=[])
+        raise NotImplementedError(f"weight update type {meta.type!r}")
 
 
 class RemoteJaxEngine(RemoteInfEngine):

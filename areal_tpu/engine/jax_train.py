@@ -51,7 +51,13 @@ from areal_tpu.models import (
     param_partition_specs,
 )
 from areal_tpu.models.hf import load_hf_params, save_hf_checkpoint
-from areal_tpu.parallel import batch_spec, build_mesh, mesh_from_alloc, shard_pytree
+from areal_tpu.parallel import (
+    batch_spec,
+    build_mesh,
+    distributed,
+    mesh_from_alloc,
+    shard_pytree,
+)
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.data import (
     RowPackedBatch,
@@ -104,6 +110,10 @@ class JaxTrainEngine(TrainEngine):
     def create_process_group(self, alloc_mode=None) -> None:
         if self.mesh is not None:
             return
+        # multi-host: join the global JAX runtime first (env-gated no-op in
+        # the single-process dev path) — the TPU equivalent of
+        # init_process_group (reference: fsdp_engine.py:112)
+        distributed.init_distributed()
         if alloc_mode is not None and getattr(alloc_mode, "train", None):
             self.mesh = mesh_from_alloc(alloc_mode.train)
         else:
@@ -252,10 +262,18 @@ class JaxTrainEngine(TrainEngine):
         return out
 
     def _device_batch(self, data: Dict[str, np.ndarray], stacked: bool):
-        """Shard host arrays: rows over (dp, fsdp), sequence over sp."""
+        """Shard host arrays: rows over (dp, fsdp), sequence over sp.
+
+        Multi-process: the batch must be identical on every process (the
+        dist-rollout coordinator broadcasts it); each process contributes
+        its local shards."""
         spec = batch_spec()
         if stacked:
             spec = P(None, *spec)
+        if jax.process_count() > 1:
+            return distributed.make_global_batch(
+                self.mesh, {k: spec for k in data}, data
+            )
         sharding = NamedSharding(self.mesh, spec)
         return {k: jax.device_put(v, sharding) for k, v in data.items()}
 
@@ -356,8 +374,11 @@ class JaxTrainEngine(TrainEngine):
                 jnp.int32(self.step_count),
             )
         # ONE host transfer for every stat; per-scalar float() would pay a
-        # device round-trip each
-        stats = {k: float(v) for k, v in jax.device_get(stats).items()}
+        # device round-trip each.  Stats are replicated reductions, so each
+        # process reads its own full replica.
+        stats = {
+            k: float(v) for k, v in distributed.fetch_replicated(stats).items()
+        }
         self.step_count += 1
         stats["total_loss_weight"] = total_weight
         stats["step_time"] = time.perf_counter() - t0
@@ -406,7 +427,7 @@ class JaxTrainEngine(TrainEngine):
             self._forward_cache[key] = jax.jit(eval_step)
         with self.mesh:
             loss, stats = self._forward_cache[key](self.params, dev_batch)
-        loss, stats = jax.device_get((loss, stats))
+        loss, stats = distributed.fetch_replicated((loss, stats))
         out = {k: float(v) for k, v in stats.items()}
         out["loss"] = float(loss) / max(total_weight, 1e-8)
         return out
@@ -455,9 +476,22 @@ class JaxTrainEngine(TrainEngine):
                 )
                 return post_hook(logits, batch)
 
-            self._forward_cache[key] = jax.jit(fwd_step)
+            # multi-process: output rows are sharded across hosts — jit
+            # replicates them so every process can read the full array
+            out_shardings = (
+                NamedSharding(self.mesh, P())
+                if jax.process_count() > 1
+                else None
+            )
+            self._forward_cache[key] = jax.jit(
+                fwd_step, out_shardings=out_shardings
+            )
         with self.mesh:
-            rows_out = np.asarray(self._forward_cache[key](self.params, dev_batch))
+            out = self._forward_cache[key](self.params, dev_batch)
+            if jax.process_count() > 1:
+                # out_shardings replicated it; read the local full replica
+                out = distributed.fetch_replicated(out)
+            rows_out = np.asarray(out)
         B, L = input_["attention_mask"].shape
         return unpack_rows(rp, rows_out, B, L)
 
@@ -466,31 +500,157 @@ class JaxTrainEngine(TrainEngine):
     # ------------------------------------------------------------------
 
     def _host_params(self):
-        return jax.tree_util.tree_map(np.asarray, self.params)
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(np.asarray, self.params)
+        # multi-process: shards live on other hosts; replicate leaf-by-leaf
+        # through jit (bounded extra memory: one leaf) and read the local
+        # replica — the role of DTensor.full_tensor() in the reference's
+        # save path (fsdp_engine.py:228-254)
+        rep = NamedSharding(self.mesh, P())
+        gather = jax.jit(lambda x: x, out_shardings=rep)
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(gather(x).addressable_data(0)), self.params
+        )
 
     def update_weights(self, meta: WeightUpdateMeta) -> None:
-        """Disk path (reference: fsdp_engine.py:403-425): dump an HF
-        checkpoint, then publish the save timestamp for the version so
-        inference clients/servers can reload."""
-        if meta.type != "disk":
-            raise NotImplementedError("transfer path lands with the gen server")
-        # same dir every update (reference behavior: fsdp_engine.py:403-425) —
-        # clients pass meta.path verbatim to servers; pause() serialises
-        # overwrite vs. reload
-        save_hf_checkpoint(
-            self._host_params(),
-            self.model_config,
-            meta.path,
-            save_dtype="bfloat16",
-            tokenizer_src=self.config.path or None,
+        """Publish fresh weights to inference servers.
+
+        - "disk" (reference: fsdp_engine.py:403-425): write an HF snapshot
+          under `meta.path/v{version}` — staged in a temp dir and renamed,
+          so a client that misses a pause can never read a half-written
+          checkpoint (round-1 weak #8) — and publish a version timestamp in
+          name_resolve.  Servers resolve the newest `v*` dir.
+        - "transfer" (reference NCCL path: fsdp_engine.py:298-401): stream
+          host-gathered bf16 arrays chunk-wise over HTTP straight into each
+          server (`/update_weights_chunk`), then commit.  No shared
+          filesystem in the loop.
+        """
+        if meta.type == "disk":
+            self._update_weights_disk(meta)
+        elif meta.type == "transfer":
+            self._update_weights_transfer(meta)
+        else:
+            raise NotImplementedError(f"weight update type {meta.type!r}")
+
+    def _update_weights_disk(self, meta: WeightUpdateMeta) -> None:
+        final = os.path.join(meta.path, f"v{self._version}")
+        tmp = os.path.join(meta.path, f".tmp-v{self._version}-{os.getpid()}")
+        if distributed.is_head():
+            host = self._host_params()
+            save_hf_checkpoint(
+                host,
+                self.model_config,
+                tmp,
+                save_dtype="bfloat16",
+                tokenizer_src=self.config.path or None,
+            )
+            if os.path.isdir(final):  # re-publish of the same version
+                import shutil
+
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            self._prune_weight_dirs(meta.path, keep=2)
+            name_resolve.add(
+                names.update_weights_from_disk(
+                    meta.experiment_name, meta.trial_name, self._version
+                ),
+                str(time.time_ns()),
+                replace=True,
+            )
+        else:
+            self._host_params()  # participate in the replication collectives
+
+    @staticmethod
+    def _prune_weight_dirs(root: str, keep: int) -> None:
+        import re
+        import shutil
+
+        vs = sorted(
+            (int(m.group(1)), d)
+            for d in os.listdir(root)
+            if (m := re.fullmatch(r"v(\d+)", d)) and os.path.isdir(os.path.join(root, d))
         )
-        name_resolve.add(
-            names.update_weights_from_disk(
-                meta.experiment_name, meta.trial_name, self._version
-            ),
-            str(time.time_ns()),
-            replace=True,
-        )
+        for _, d in vs[:-keep]:
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+    def _server_addrs(self, meta: WeightUpdateMeta, timeout: float = 30.0) -> list:
+        """Same discovery chain as the rollout client
+        (core/remote.py:_discover_servers), with a registration-race poll."""
+        env = os.environ.get("AREAL_LLM_SERVER_ADDRS")
+        if env:
+            return env.split(",")
+        key = names.gen_servers(meta.experiment_name, meta.trial_name)
+        deadline = time.monotonic() + timeout
+        while True:
+            found = name_resolve.get_subtree(key)
+            if found:
+                return sorted(found)
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "no generation servers registered for weight transfer"
+                )
+            time.sleep(0.5)
+
+    def _update_weights_transfer(self, meta: WeightUpdateMeta) -> None:
+        """Chunk-streamed push: each HF-named array is sliced into
+        <= chunk_mb byte pieces, POSTed to every server, then committed
+        (server assembles by (name, offset) — gen/server.py)."""
+        import asyncio
+        import base64
+
+        import ml_dtypes
+
+        from areal_tpu.models.hf import params_to_hf_state
+        from areal_tpu.utils.http import arequest_with_retry
+
+        host = self._host_params()
+        if not distributed.is_head():
+            return
+        addrs = self._server_addrs(meta)
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        chunk_bytes = max(1, meta.chunk_mb) << 20
+        # bf16 raw bytes are built while the host tree is alive (fp32
+        # masters: transient ~3x model bytes), then the host tree is
+        # dropped so only ~1x bf16 remains for the push; base64 is produced
+        # one chunk at a time inside push()
+        state = [
+            (name, np.ascontiguousarray(arr.astype(bf16)).tobytes(), list(arr.shape))
+            for name, arr in params_to_hf_state(host, self.model_config)
+        ]
+        del host
+        version = self._version
+
+        async def push(addr: str):
+            for name, raw, shape in state:
+                for off in range(0, len(raw) or 1, chunk_bytes):
+                    await arequest_with_retry(
+                        addr=addr,
+                        endpoint="/update_weights_chunk",
+                        payload={
+                            "name": name,
+                            "dtype": "bfloat16",
+                            "shape": shape,
+                            "nbytes": len(raw),
+                            "offset": off,
+                            "data_b64": base64.b64encode(
+                                raw[off : off + chunk_bytes]
+                            ).decode(),
+                        },
+                        method="POST",
+                        timeout=300.0,
+                    )
+            await arequest_with_retry(
+                addr=addr,
+                endpoint="/update_weights_chunk",
+                payload={"commit": True, "version": version},
+                method="POST",
+                timeout=600.0,
+            )
+
+        async def run():
+            await asyncio.gather(*[push(a) for a in addrs])
+
+        asyncio.run(run())
 
     def save(self, meta: SaveLoadMeta) -> None:
         save_hf_checkpoint(

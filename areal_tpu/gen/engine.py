@@ -8,9 +8,20 @@ Design for XLA's static shapes:
 - `n_slots` concurrent sequences in a preallocated KV cache
   [L, S, M, Hkv, hd]; admission assigns a free slot, completion frees it —
   continuous batching without shape changes.
-- TWO compiled programs: `forward_prefill` per prompt bucket (power-of-two
-  padded) and ONE `forward_decode` step advancing every slot; idle slots
-  decode garbage that is never read (cheaper than recompiling for occupancy).
+- TWO compiled programs: `forward_prefill` per (rows, prompt-bucket) pair
+  (both power-of-two padded) and ONE `forward_decode` step advancing every
+  slot; idle slots decode garbage that is never read (cheaper than
+  recompiling for occupancy).
+- **Batched admission**: every free slot is filled from the pending queue in
+  ONE prefill call (rows padded to a power of two, dummy rows target a
+  scratch cache slot) — a burst of N prompts costs O(log N) device
+  round-trips, not N.
+- **Model-parallel serving**: with `tp > 1` the engine owns a
+  (dp=1, fsdp=1, sp=1, tp) mesh; params shard with the same
+  `param_partition_specs` the trainer uses (megatron column/row layout) and
+  the KV cache shards its kv-head axis, so a 7B model serves across chips
+  the way the reference serves via SGLang's server-side tp
+  (areal/api/alloc_mode.py:377 inference d x t x p).
 - Cache and rng are donated; steady-state decode allocates nothing.
 - Weight reload (`load_weights`) aborts in-flight requests with
   stop_reason="abort" — the client's interruption loop resubmits with
@@ -28,6 +39,8 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from areal_tpu.gen.sampling import sample_tokens
 from areal_tpu.models.model_config import TransformerConfig
@@ -36,8 +49,10 @@ from areal_tpu.models.transformer import (
     forward_prefill,
     init_kv_cache,
     init_params,
+    param_partition_specs,
 )
 from areal_tpu.models.hf import load_hf_params
+from areal_tpu.parallel import build_mesh, shard_pytree
 from areal_tpu.utils import logging
 from areal_tpu.utils.datapack import round_up_to_bucket
 
@@ -79,6 +94,8 @@ class GenEngine:
         kv_dtype: str = "bfloat16",
         seed: int = 0,
         decode_chunk: int = 8,
+        tp: int = 1,
+        devices=None,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -90,21 +107,41 @@ class GenEngine:
                 params = host
             else:
                 params = init_params(self.model_config, jax.random.PRNGKey(seed))
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.tp = tp
+        if tp > 1 and self.model_config.num_kv_heads % tp != 0:
+            raise ValueError(
+                f"tp={tp} must divide num_kv_heads="
+                f"{self.model_config.num_kv_heads} (kv-head-sharded cache)"
+            )
+        # serving mesh: tensor parallel only — dp across servers is the
+        # client's job (core/remote.py multi-server routing), so the mesh
+        # reuses the trainer's partition specs with dp=fsdp=sp=1
+        self.mesh = build_mesh(dp=1, fsdp=1, sp=1, tp=tp, devices=devices)
+        self._pspecs = param_partition_specs(self.model_config, tp=tp)
+        self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.n_slots = n_slots
         self.max_seq_len = max_seq_len
         self.prompt_bucket = prompt_bucket
-        self.cache = init_kv_cache(self.model_config, n_slots, max_seq_len, kv_dtype)
+        self.kv_dtype = kv_dtype
+        # slot n_slots is the scratch row: dummy admission rows (power-of-two
+        # padding) prefill into it, and decode advances it harmlessly
+        self._cache_spec = P(None, None, None, "tp", None)
+        cache = init_kv_cache(self.model_config, n_slots + 1, max_seq_len, kv_dtype)
+        self.cache = {
+            k: jax.device_put(v, NamedSharding(self.mesh, self._cache_spec))
+            for k, v in cache.items()
+        }
         self.rng = jax.random.PRNGKey(seed)
         self.version = 0
 
-        # host-side slot state
-        self.slot_req: List[Optional[GenRequest]] = [None] * n_slots
-        self.lengths = np.zeros(n_slots, np.int32)
-        self.last_tokens = np.zeros(n_slots, np.int32)
-        self.temperature = np.ones(n_slots, np.float32)
-        self.top_p = np.ones(n_slots, np.float32)
-        self.top_k = np.zeros(n_slots, np.int32)
+        # host-side slot state (scratch slot included, never assigned)
+        S = n_slots + 1
+        self.slot_req: List[Optional[GenRequest]] = [None] * S
+        self.lengths = np.zeros(S, np.int32)
+        self.last_tokens = np.zeros(S, np.int32)
+        self.temperature = np.ones(S, np.float32)
+        self.top_p = np.ones(S, np.float32)
+        self.top_k = np.zeros(S, np.int32)
         self.pending: "queue.Queue[GenRequest]" = queue.Queue()
         self._lock = threading.Lock()
 
@@ -117,9 +154,9 @@ class GenEngine:
         self.decode_chunk = max(1, decode_chunk)
         cfg = self.model_config
 
-        def _prefill(params, cache, ids, plen, slot, rng, temp, tp, tk):
-            logits, cache = forward_prefill(params, cfg, ids, plen, cache, slot)
-            tok, logp = sample_tokens(logits, rng, temp, tk, tp)
+        def _prefill(params, cache, ids, plen, slot_ids, rng, temp, tp, tk):
+            logits, cache = forward_prefill(params, cfg, ids, plen, cache, slot_ids)
+            tok, logp = sample_tokens(logits.astype(jnp.float32), rng, temp, tk, tp)
             return tok, logp, cache
 
         def _decode_chunk(params, cache, tokens, lengths, rng, temp, tp, tk, n):
@@ -186,50 +223,101 @@ class GenEngine:
             logger.info(f"aborted {aborted} requests for weight update")
         if params is None:
             assert path is not None
+            path, dir_version = self._resolve_ckpt_dir(path)
+            if version is None:
+                # adopt the trainer's version from the v{N} dir name — a
+                # fresh server must not restart its version counter at 1
+                # while the trainer is at N (staleness gates compare them)
+                version = dir_version
             params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.version = version if version is not None else self.version + 1
         return self.version
+
+    @staticmethod
+    def _resolve_ckpt_dir(path: str):
+        """Trainers publish atomic per-version snapshots `root/v{N}`
+        (jax_train.py _update_weights_disk); pick the newest and return
+        (dir, version).  A plain checkpoint dir (config.json present) is
+        used as-is with version None."""
+        import os
+        import re
+
+        if os.path.exists(os.path.join(path, "config.json")):
+            return path, None
+        vs = sorted(
+            (int(m.group(1)), os.path.join(path, d))
+            for d in (os.listdir(path) if os.path.isdir(path) else [])
+            if (m := re.fullmatch(r"v(\d+)", d))
+        )
+        if not vs:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        return vs[-1][1], vs[-1][0]
 
     # ------------------------------------------------------------------
     # stepping
     # ------------------------------------------------------------------
 
     def _admit(self) -> None:
-        for s in range(self.n_slots):
-            if self.slot_req[s] is not None:
-                continue
+        """Fill every free slot from the pending queue in ONE bucketed
+        prefill call.  Rows are padded to a power of two; padding rows
+        prefill a single token into the scratch slot (index n_slots), so
+        compiled-program count stays O(log n_slots x log buckets) and a
+        burst of N prompts no longer pays N sequential device round-trips
+        (round-1 review weak #2)."""
+        free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
+        admitted: List[tuple] = []  # (slot, req)
+        while free:
             try:
                 req = self.pending.get_nowait()
             except queue.Empty:
-                return
-            plen = len(req.input_ids)
-            bucket = round_up_to_bucket(
-                max(plen, 1), self.prompt_bucket, self.max_seq_len
-            )
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :plen] = req.input_ids
-            self.rng, sub = jax.random.split(self.rng)
-            tok, logp, self.cache = self._prefill_fn(
-                self.params,
-                self.cache,
-                ids,
-                jnp.asarray([plen], jnp.int32),
-                s,
-                sub,
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_p], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-            )
-            tok, logp = int(tok[0]), float(logp[0])
-            with self._lock:
+                break
+            admitted.append((free.pop(0), req))
+        if not admitted:
+            return
+        bucket = round_up_to_bucket(
+            max(max(len(r.input_ids) for _, r in admitted), 1),
+            self.prompt_bucket,
+            self.max_seq_len,
+        )
+        S = 1 << (len(admitted) - 1).bit_length()  # power-of-two rows
+        ids = np.zeros((S, bucket), np.int32)
+        plens = np.ones(S, np.int32)
+        slot_ids = np.full(S, self.n_slots, np.int32)  # default: scratch
+        temp = np.ones(S, np.float32)
+        top_p = np.ones(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        for i, (s, req) in enumerate(admitted):
+            n = len(req.input_ids)
+            ids[i, :n] = req.input_ids
+            plens[i] = n
+            slot_ids[i] = s
+            temp[i] = req.temperature
+            top_p[i] = req.top_p
+            top_k[i] = req.top_k
+        self.rng, sub = jax.random.split(self.rng)
+        toks, logps, self.cache = self._prefill_fn(
+            self.params,
+            self.cache,
+            ids,
+            jnp.asarray(plens),
+            jnp.asarray(slot_ids),
+            sub,
+            jnp.asarray(temp),
+            jnp.asarray(top_p),
+            jnp.asarray(top_k),
+        )
+        toks, logps = np.asarray(toks), np.asarray(logps)
+        with self._lock:
+            for i, (s, req) in enumerate(admitted):
                 self.slot_req[s] = req
-                self.lengths[s] = plen
-                self.last_tokens[s] = tok
+                self.lengths[s] = plens[i]
+                self.last_tokens[s] = int(toks[i])
                 self.temperature[s] = req.temperature
                 self.top_p[s] = req.top_p
                 self.top_k[s] = req.top_k
-            self._record_token(s, tok, logp)
+        for i, (s, req) in enumerate(admitted):
+            self._record_token(s, int(toks[i]), float(logps[i]))
 
     def _record_token(self, s: int, tok: int, logp: float) -> None:
         req = self.slot_req[s]

@@ -46,6 +46,7 @@ class GenServer:
         self.shutdown = threading.Event()
         self._weight_futures: "list" = []
         self._chunk_buf = {}
+        self._last_committed_version: Optional[int] = None
         self._cmd_lock = threading.Lock()
         self._pending_weight_update: Optional[dict] = None
         self.worker = threading.Thread(target=self._run, daemon=True)
@@ -150,14 +151,29 @@ class GenServer:
         return web.json_response({"ok": True, "version": version})
 
     async def update_weights_chunk(self, request: web.Request) -> web.Response:
-        """Transfer path: the trainer streams named arrays; `commit` swaps
-        them in (counterpart of the reference's NCCL broadcast bucket
-        protocol, fsdp_engine.py:298-330, over HTTP/DCN instead)."""
+        """Transfer path: the trainer streams named arrays — whole, or as
+        (offset, bytes) pieces for arrays larger than the chunk budget —
+        and `commit` swaps them in (counterpart of the reference's NCCL
+        broadcast bucket protocol, fsdp_engine.py:298-330, over HTTP/DCN)."""
         body = await request.json()
         if body.get("commit"):
+            if not self._chunk_buf:
+                # idempotent retry: a commit whose response was lost leaves
+                # an empty buffer — if that version is already live, say so
+                # instead of failing a transfer that in fact succeeded
+                if (
+                    body.get("version") is None
+                    or body["version"] == self._last_committed_version
+                ):
+                    return web.json_response(
+                        {"ok": True, "version": self.engine.version}
+                    )
+                return web.json_response(
+                    {"error": "commit without staged chunks"}, status=409
+                )
             from areal_tpu.models.hf import state_to_params
 
-            host = self._chunk_buf
+            host = {name: self._assemble(e) for name, e in self._chunk_buf.items()}
             self._chunk_buf = {}
             params = state_to_params(
                 iter(host.items()), self.engine.model_config, dtype="bfloat16"
@@ -166,12 +182,34 @@ class GenServer:
                 params=params, version=body.get("version")
             )
             version = await asyncio.wrap_future(fut)
+            self._last_committed_version = version
             return web.json_response({"ok": True, "version": version})
-        arr = np.frombuffer(
-            base64.b64decode(body["data_b64"]), dtype=np.dtype(body["dtype"])
-        ).reshape(body["shape"])
-        self._chunk_buf[body["name"]] = arr
-        return web.json_response({"ok": True, "received": body["name"]})
+        name = body["name"]
+        data = base64.b64decode(body["data_b64"])
+        entry = self._chunk_buf.setdefault(
+            name,
+            {
+                "buf": bytearray(int(body["nbytes"])),
+                "dtype": body["dtype"],
+                "shape": body["shape"],
+            },
+        )
+        off = int(body["offset"])
+        entry["buf"][off : off + len(data)] = data
+        return web.json_response({"ok": True, "received": name})
+
+    @staticmethod
+    def _assemble(entry) -> np.ndarray:
+        import ml_dtypes
+
+        dtype = (
+            np.dtype(ml_dtypes.bfloat16)
+            if entry["dtype"] == "bfloat16"
+            else np.dtype(entry["dtype"])
+        )
+        return np.frombuffer(bytes(entry["buf"]), dtype=dtype).reshape(
+            entry["shape"]
+        )
 
     async def health(self, request: web.Request) -> web.Response:
         if not self.worker.is_alive() and not self.shutdown.is_set():
@@ -240,6 +278,9 @@ def main():
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: shard the model + KV cache "
+                        "over the first tp local devices")
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
     p.add_argument("--server-idx", type=int, default=0)
@@ -251,10 +292,11 @@ def main():
             model_path=args.model_path,
             n_slots=args.n_slots,
             max_seq_len=args.max_seq_len,
+            tp=args.tp,
         )
     else:
         engine = GenEngine(tiny_config(), n_slots=args.n_slots,
-                           max_seq_len=args.max_seq_len)
+                           max_seq_len=args.max_seq_len, tp=args.tp)
     serve(
         engine,
         port=args.port or None,

@@ -266,9 +266,10 @@ def forward_prefill(
     input_ids: jax.Array,  # [S, P] padded prompt bucket (may be 1 row)
     prompt_lens: jax.Array,  # [S]
     cache: Dict[str, jax.Array],
-    slot_offset: jax.Array,  # scalar: first cache slot these rows occupy
+    slot_ids: jax.Array,  # int32 [S]: cache slot each row occupies
 ):
-    """Prefill `input_ids` into cache slots [slot_offset, slot_offset+S);
+    """Prefill `input_ids` into cache slots `slot_ids` (arbitrary, possibly
+    non-contiguous — batched admission fills whichever slots are free);
     returns (last-token logits [S, V], updated cache)."""
     S, P = input_ids.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -285,12 +286,8 @@ def forward_prefill(
         q, k, v = _qkv(cfg, lp, h, dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        ck = jax.lax.dynamic_update_slice(
-            ck, k.astype(ck.dtype), (slot_offset, 0, 0, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v.astype(cv.dtype), (slot_offset, 0, 0, 0)
-        )
+        ck = ck.at[slot_ids, :P].set(k.astype(ck.dtype))
+        cv = cv.at[slot_ids, :P].set(v.astype(cv.dtype))
         attn = attention(q, k, v, mask, cfg.attn_logit_softcap)
         x = x + jnp.einsum(
             "bth,hd->btd", attn.reshape(S, P, cfg.q_size), lp["attn"]["wo"].astype(dtype)
@@ -411,7 +408,7 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
     return params
 
 
-def param_partition_specs(cfg: TransformerConfig) -> Params:
+def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
     """PartitionSpecs over mesh axes ("fsdp", "tp").
 
     Layout follows the megatron/GSPMD convention the reference realises with
@@ -419,7 +416,11 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
     Column/RowParallelLinear (realhf .../tensor_parallel/modules.py:737,885):
     qkv & mlp-in column-split over tp, attn-out & mlp-down row-split; the
     other axis is ZeRO-sharded over fsdp.  Vocab-parallel embedding/head.
+
+    Pass the mesh's `tp` size to drop the vocab sharding when the vocab
+    is not divisible (odd test vocabs; real vocabs are multiples of 128).
     """
+    vocab_axis = "tp" if (tp == 0 or cfg.vocab_size % max(tp, 1) == 0) else None
     attn = {
         "wq": P(None, "fsdp", "tp"),
         "wk": P(None, "fsdp", "tp"),
@@ -431,7 +432,7 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
     if cfg.qk_norm:
         attn.update(q_norm=P(None, None), k_norm=P(None, None))
     specs: Params = {
-        "embedding": P("tp", "fsdp"),
+        "embedding": P(vocab_axis, "fsdp"),
         "layers": {
             "attn": attn,
             "mlp": {
@@ -445,7 +446,7 @@ def param_partition_specs(cfg: TransformerConfig) -> Params:
         "final_norm": P("fsdp"),
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P("fsdp", "tp")
+        specs["lm_head"] = P("fsdp", vocab_axis)
     return specs
 
 

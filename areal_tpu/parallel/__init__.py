@@ -1,3 +1,4 @@
+from areal_tpu.parallel import distributed
 from areal_tpu.parallel.mesh import (
     MeshAxes,
     batch_spec,
@@ -11,6 +12,7 @@ from areal_tpu.parallel.mesh import (
 __all__ = [
     "MeshAxes",
     "build_mesh",
+    "distributed",
     "mesh_from_alloc",
     "batch_spec",
     "named_sharding",

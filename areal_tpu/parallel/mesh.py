@@ -84,10 +84,24 @@ def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
 
 
 def shard_pytree(mesh: Mesh, tree: Any, specs: Any) -> Any:
-    """device_put every leaf with its NamedSharding (specs mirrors tree)."""
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
-    )
+    """Host pytree -> sharded device pytree (specs mirrors tree).
+
+    Single-process: plain device_put.  Multi-process: every process holds
+    the identical host values and contributes its local shards via
+    make_array_from_callback (device_put cannot target non-addressable
+    devices)."""
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        )
+
+    def _make(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, s), lambda idx, x=x: x[idx]
+        )
+
+    return jax.tree_util.tree_map(_make, tree, specs)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

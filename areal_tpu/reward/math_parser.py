@@ -1,18 +1,28 @@
 """Math answer extraction and verification.
 
 Behavioral counterpart of the reference's rule-based math verifier
-(areal/reward/math_parser.py, 867 LoC with vendored latex2sympy;
-realhf/impl/model/interface/math_rw_interface.py): extract the model's final
-answer (\\boxed{...}, "the answer is", or trailing expression), normalise
-latex/number formatting, and compare against ground truth — string match,
-then numeric, then sympy symbolic equivalence.
+(areal/reward/math_parser.py:219 strip_string, :360 extract_answer, :495
+math_equal, backed by vendored latex2sympy in evaluation/): extract the
+model's final answer, normalise latex/number/unit formatting, and compare —
+string match, then numeric (with the reference's percentage tolerance),
+then element-wise for tuples/intervals/matrices, then sympy symbolic
+equivalence.  antlr/latex2sympy is not available in this image, so latex is
+lowered to sympy-parsable text by an in-repo rewriter instead of a vendored
+grammar.
+
+Reward honesty (round-1 review weak #6): `extract_answer` used as a REWARD
+signal is strict — it requires an explicit answer marker (\\boxed{},
+"the answer is", "####", "$ ... $. I hope") and returns None otherwise.
+The permissive last-number fallback the reference enables for offline eval
+(`use_last_number=True`) exists behind `strict=False` only; RL reward
+functions never use it, so emitting any number cannot farm reward.
 
 Runs inside the reward process pool (api/reward.py), so sympy hangs are
 bounded by the pool timeout rather than an in-process alarm.
 """
 
 import re
-from typing import Optional
+from typing import List, Optional
 
 # --------------------------------------------------------------------------
 # extraction
@@ -41,12 +51,23 @@ def _find_boxed(text: str) -> Optional[str]:
 
 
 _ANSWER_PATTERNS = [
-    r"(?:final answer|the answer)\s*(?:is|:)?\s*([^\n\.]+)",
+    r"(?:final answer|the answer)\s*(?:is\s*:?|:)\s*([^\n]+)",
     r"####\s*([^\n]+)",
 ]
 
 
-def extract_answer(text: str) -> Optional[str]:
+def extract_answer(text: str, strict: bool = True) -> Optional[str]:
+    """Pull the final answer out of a model completion.
+
+    strict=True (reward path): only explicit answer markers count.
+    strict=False (offline eval): additionally falls back to the last number
+    in the text (reference extract_answer's use_last_number=True)."""
+    if not text:
+        return None
+    # minerva-style "final answer is $X$. I hope it is correct."
+    if "final answer is $" in text and "$. I hope" in text:
+        frag = text.split("final answer is $", 1)[1].split("$. I hope", 1)[0]
+        return frag.strip()
     boxed = _find_boxed(text)
     if boxed is not None:
         return boxed.strip()
@@ -55,64 +76,153 @@ def extract_answer(text: str) -> Optional[str]:
         matches = list(re.finditer(pat, low))
         if matches:
             m = matches[-1]
-            return text[m.start(1) : m.end(1)].strip()
-    # fall back to the last number in the text
-    nums = re.findall(r"-?\d[\d,]*(?:\.\d+)?", text)
-    return nums[-1] if nums else None
+            ans = text[m.start(1) : m.end(1)].strip()
+            # trim trailing prose after the expression: "is 42. Done" -> 42
+            ans = re.split(r"(?<=[\d\w)\]}])\.\s", ans)[0]
+            return ans.rstrip(".").strip()
+    if not strict:
+        nums = re.findall(r"-?\d[\d,]*(?:\.\d+)?", text)
+        return nums[-1].replace(",", "") if nums else None
+    return None
 
 
 # --------------------------------------------------------------------------
-# normalisation & comparison
+# normalisation
 # --------------------------------------------------------------------------
+
+_WORD_NUMBERS = {
+    "zero": "0", "one": "1", "two": "2", "three": "3", "four": "4",
+    "five": "5", "six": "6", "seven": "7", "eight": "8", "nine": "9",
+    "ten": "10", "eleven": "11", "twelve": "12",
+}
+
+# unit words stripped when attached to a number (reference strip_string's
+# unit_texts table role); conservative: only straightforward count units
+_UNIT_WORDS = [
+    "degrees?", "dollars?", "cents?", "percent", "points?", "units?",
+    "meters?", "metres?", "miles?", "feet", "foot", "inch(?:es)?",
+    "centimeters?", "kilometers?", "km", "cm", "mm", "kg", "grams?",
+    "pounds?", "ounces?", "liters?", "litres?", "ml",
+    "seconds?", "minutes?", "hours?", "days?", "weeks?", "months?",
+    "years?", "mph", "km/h", "sq", "square", "cubic", "per",
+]
+_UNIT_RE = re.compile(
+    r"(?<=[\d\s.)])\s*\\?(?:" + "|".join(_UNIT_WORDS) + r")\b\.?", re.IGNORECASE
+)
 
 _LATEX_SUBS = [
     (r"\\left|\\right", ""),
-    (r"\\!|\\,|\\;|\\:|~", ""),
-    (r"\\text\{([^{}]*)\}", r"\1"),
+    (r"\\!|\\,|\\;|\\:|\\ ", ""),
+    (r"~", " "),
     (r"\\mathrm\{([^{}]*)\}", r"\1"),
+    (r"\\mathbf\{([^{}]*)\}", r"\1"),
+    (r"\\mbox\{[^{}]*\}$", ""),
     (r"\\mbox\{([^{}]*)\}", r"\1"),
     (r"\\\$|\$", ""),
     (r"\\%|%", ""),
-    (r"\\dfrac", r"\\frac"),
-    (r"\\tfrac", r"\\frac"),
-    (r"\\cdot", "*"),
-    (r"\\times", "*"),
-    (r"\\div", "/"),
-    (r"\\pi", "pi"),
-    (r"\\infty", "oo"),
-    (r"\\circ", ""),
+    (r"\^\{?\\circ\}?", ""),
     (r"\\degree", ""),
-    (r"\s+", ""),
+    (r"\\dfrac|\\tfrac|\\cfrac", r"\\frac"),
+    (r"\\cdot|\\times", "*"),
+    (r"\\div", "/"),
+    (r"\\pi\b", "pi"),
+    (r"\\infty|infinity|\binf\b", "oo"),
+    (r"\\ne(?:q)?\b", "!="),
+    (r"\\le(?:q)?\b", "<="),
+    (r"\\ge(?:q)?\b", ">="),
+    (r"\\approx", "="),
+    (r"\\begin\{array\}\{[^{}]*\}", r"\\begin{pmatrix}"),
+    (r"\\end\{array\}", r"\\end{pmatrix}"),
+    (r"bmatrix|vmatrix|Bmatrix", "pmatrix"),
+    (r"\\in\b", "="),
 ]
 
 
-def normalize_answer(ans: str) -> str:
-    s = ans.strip()
-    for pat, rep in _LATEX_SUBS:
-        s = re.sub(pat, rep, s)
-    # \frac{a}{b} -> (a)/(b)
-    while True:
-        m = re.search(r"\\frac\{([^{}]*)\}\{([^{}]*)\}", s)
+def _fix_fracs(s: str) -> str:
+    """All \\frac spellings -> ((a)/(b)): braced (one nesting level deep),
+    half-braced (\\frac{a}b), and bare two-token (\\frac12, \\frac1x)
+    forms.  Innermost fracs resolve first, so \\frac{\\frac{1}{2}}{3}
+    converges over iterations."""
+    token = r"(\{(?:[^{}]|\{[^{}]*\})*\}|[^\s{}\\])"
+    pat = re.compile(r"\\frac\s*" + token + r"\s*" + token)
+    for _ in range(10):  # bounded fixpoint
+        m = pat.search(s)
         if not m:
             break
-        s = s[: m.start()] + f"(({m.group(1)})/({m.group(2)}))" + s[m.end() :]
-    s = re.sub(r"\\sqrt\{([^{}]*)\}", r"sqrt(\1)", s)
-    s = re.sub(r"\\sqrt(\w)", r"sqrt(\1)", s)
-    s = s.replace("^", "**").replace("{", "(").replace("}", ")")
-    s = s.replace(",", "")  # thousands separators
-    s = s.rstrip(".")
-    # drop a single unbalanced paren at either end; never touch balanced ones
-    if s.count("(") > s.count(")"):
-        if s.endswith("("):
-            s = s[:-1]
-        elif s.startswith("("):
-            s = s[1:]
-    elif s.count(")") > s.count("("):
-        if s.startswith(")"):
-            s = s[1:]
-        elif s.endswith(")"):
-            s = s[:-1]
+        num, den = (
+            g[1:-1] if g.startswith("{") and g.endswith("}") else g
+            for g in m.groups()
+        )
+        s = s[: m.start()] + f"(({num})/({den}))" + s[m.end() :]
+    return s
+
+
+def _fix_sqrt(s: str) -> str:
+    s = re.sub(r"\\sqrt\s*\{([^{}]*)\}", r"sqrt(\1)", s)
+    s = re.sub(r"\\sqrt\s*(\w)", r"sqrt(\1)", s)
+    return s
+
+
+def _fix_mixed_number(s: str) -> str:
+    """3\\frac{1}{2} and '3 1/2' style mixed numbers -> (3+(1)/(2))."""
+    m = re.fullmatch(r"(-?\d+)\s*\(\((\d+)\)/\((\d+)\)\)", s)
+    if m:
+        whole, num, den = m.groups()
+        sign = "-" if whole.startswith("-") else "+"
+        return f"({whole}{sign}({num})/({den}))"
+    return s
+
+
+def normalize_answer(ans: str) -> str:
+    s = str(ans).strip().replace("\n", "")
+    s = s.rstrip(".").rstrip("/")
+    s = re.sub(r"\\text\s*\{([^{}]*)\}", r"\1", s)
+    s = _UNIT_RE.sub("", s)
+    for pat, rep in _LATEX_SUBS:
+        s = re.sub(pat, rep, s)
+    for w, d in _WORD_NUMBERS.items():
+        s = re.sub(rf"\b{w}\b", d, s, flags=re.IGNORECASE)
+    s = _fix_sqrt(s)  # before fracs: \frac{\sqrt{3}}{3} loses inner braces
+    s = _fix_fracs(s)
+    # "x = 5" / "k=5" style prefixes: keep the value side.  lhs must be a
+    # bare variable name — '<='/'>=' from the \le/\ge rewrites must NOT
+    # count, else inequalities collapse to their number
+    if s.count("=") == 1:
+        lhs, rhs = s.split("=")
+        lhs = lhs.strip()
+        if len(lhs) <= 2 and lhs.isalnum() and rhs.strip():
+            s = rhs
+    s = s.replace("^", "**")
+    # whitespace first so '(1, 234)' and '(1,234)' normalise identically,
+    # THEN thousands separators inside digit groups — ambiguous 3-digit
+    # tuples resolve to the same reading on both sides of a comparison
+    s = re.sub(r"\s+", "", s)
+    s = re.sub(r"(\d),(?=\d{3}(\D|$))", r"\1", s)
+    s = s.replace("{", "(").replace("}", ")")
+    s = _fix_mixed_number(s)
+    # ".5" -> "0.5", "2.0" -> "2"
+    s = re.sub(r"(?<![\d.])\.(\d)", r"0.\1", s)
+    s = re.sub(r"(\d+)\.0+(?=\D|$)", r"\1", s)
+    # drop a single unbalanced paren at either end; never touch balanced
+    # ones, and never touch half-open intervals like '[1/2, 1)' where the
+    # 'unbalanced' paren is matched by a square bracket
+    if "[" not in s and "]" not in s:
+        if s.count("(") > s.count(")"):
+            if s.endswith("("):
+                s = s[:-1]
+            elif s.startswith("("):
+                s = s[1:]
+        elif s.count(")") > s.count("("):
+            if s.startswith(")"):
+                s = s[1:]
+            elif s.endswith(")"):
+                s = s[:-1]
     return s.lower()
+
+
+# --------------------------------------------------------------------------
+# comparison
+# --------------------------------------------------------------------------
 
 
 def _to_number(s: str) -> Optional[float]:
@@ -129,26 +239,116 @@ def _to_number(s: str) -> Optional[float]:
     return None
 
 
-def math_equal(pred: str, target: str, rel_tol: float = 1e-4) -> bool:
+def _split_top_level(s: str) -> Optional[List[str]]:
+    """'(a,b,c)' / '[a,b)' -> top-level comma split, else None."""
+    if len(s) < 2 or s[0] not in "([" or s[-1] not in ")]":
+        return None
+    inner = s[1:-1]
+    parts, depth, cur = [], 0, ""
+    for c in inner:
+        if c in "([":
+            depth += 1
+        elif c in ")]":
+            depth -= 1
+        if c == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += c
+    parts.append(cur)
+    return parts if len(parts) > 1 else None
+
+
+def _pmatrix_rows(s: str) -> Optional[List[List[str]]]:
+    m = re.fullmatch(r"\\begin\(pmatrix\)(.*)\\end\(pmatrix\)", s)
+    if not m:
+        return None
+    return [row.split("&") for row in m.group(1).split("\\\\") if row]
+
+
+def _sympy_equal(p: str, t: str) -> bool:
+    import sympy
+    from sympy.parsing.sympy_parser import (
+        implicit_multiplication_application,
+        parse_expr,
+        standard_transformations,
+    )
+
+    transforms = standard_transformations + (implicit_multiplication_application,)
+
+    def parse(s):
+        return parse_expr(s, transformations=transforms, evaluate=True)
+
+    try:
+        pe, te = parse(p), parse(t)
+    except Exception:  # noqa: BLE001 — unparseable => not equal
+        return False
+    try:
+        if pe == te:
+            return True
+        diff = sympy.simplify(pe - te)
+        return diff == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def math_equal(
+    pred: str,
+    target: str,
+    rel_tol: float = 1e-4,
+    include_percentage: bool = True,
+    depth: int = 0,
+) -> bool:
+    """Graded equivalence (reference math_parser.math_equal:495): exact
+    string -> numeric (with /100, x100 percentage forms) -> element-wise
+    tuples/intervals/matrices -> equation sides -> sympy symbolic."""
     if pred is None or target is None:
         return False
     p, t = normalize_answer(str(pred)), normalize_answer(str(target))
     if p == t:
         return True
+
     pn, tn = _to_number(p), _to_number(t)
     if pn is not None and tn is not None:
-        return abs(pn - tn) <= rel_tol * max(1.0, abs(tn))
-    if (pn is None) != (tn is None):
-        # one side numeric, other symbolic: let sympy decide
-        pass
-    try:
-        import sympy
-        from sympy.parsing.sympy_parser import parse_expr
+        candidates = [tn]
+        if include_percentage:
+            candidates = [tn / 100.0, tn, tn * 100.0]
+        return any(
+            abs(pn - c) <= rel_tol * max(1.0, abs(c)) for c in candidates
+        )
 
-        diff = sympy.simplify(parse_expr(p) - parse_expr(t))
-        return diff == 0
-    except Exception:  # noqa: BLE001 — unparseable => not equal
-        return False
+    if depth < 3:
+        # tuples / intervals / coordinate pairs: element-wise
+        pp, tt = _split_top_level(p), _split_top_level(t)
+        if pp is not None and tt is not None:
+            if len(pp) != len(tt) or p[0] != t[0] or p[-1] != t[-1]:
+                return False
+            return all(
+                math_equal(a, b, rel_tol, include_percentage, depth + 1)
+                for a, b in zip(pp, tt)
+            )
+        # matrices: element-wise over rows
+        pm, tm = _pmatrix_rows(p), _pmatrix_rows(t)
+        if pm is not None and tm is not None:
+            if len(pm) != len(tm):
+                return False
+            return all(
+                len(pr) == len(tr)
+                and all(
+                    math_equal(a, b, rel_tol, include_percentage, depth + 1)
+                    for a, b in zip(pr, tr)
+                )
+                for pr, tr in zip(pm, tm)
+            )
+        # single equations: compare both sides
+        if p.count("=") == 1 and t.count("=") == 1:
+            pl, pr = p.split("=")
+            tl, tr = t.split("=")
+            return math_equal(
+                pl, tl, rel_tol, include_percentage, depth + 1
+            ) and math_equal(pr, tr, rel_tol, include_percentage, depth + 1)
+
+    return _sympy_equal(p, t)
 
 
 # --------------------------------------------------------------------------
@@ -158,12 +358,13 @@ def math_equal(pred: str, target: str, rel_tol: float = 1e-4) -> bool:
 
 
 def gsm8k_reward_fn(prompt, completions, prompt_ids, completion_ids, answer, **kw):
-    pred = extract_answer(completions)
+    pred = extract_answer(completions, strict=True)
     return float(pred is not None and math_equal(pred, answer))
 
 
 def math_verify_reward(prompt, completions, prompt_ids, completion_ids, solution=None,
                        answer=None, **kw):
-    target = answer if answer is not None else extract_answer(solution or "")
-    pred = extract_answer(completions)
+    target = answer if answer is not None else extract_answer(solution or "",
+                                                              strict=False)
+    pred = extract_answer(completions, strict=True)
     return float(pred is not None and target is not None and math_equal(pred, target))

@@ -81,7 +81,7 @@ def _make_batch(rng, n_rows, row_len, vocab, seqs_per_row=2):
     }
 
 
-def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2):
+def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2, group_size=2):
     import jax
 
     from areal_tpu.api.config import (
@@ -108,10 +108,12 @@ def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2):
         optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
         pack_length_quantum=row_len,
         max_pack_length=row_len,
-        group_size=2,
+        group_size=group_size,
         ppo_n_minibatches=1,
         use_decoupled_loss=True,
-        adv_norm=NormConfig(mean_level="group", std_level="group", group_size=2),
+        adv_norm=NormConfig(
+            mean_level="group", std_level="group", group_size=group_size
+        ),
     )
     actor = JaxPPOActor(cfg, model_config=model_cfg)
     actor.initialize(ft_spec=FinetuneSpec(1, 1024, 8))
@@ -195,7 +197,8 @@ def main():
     # splash path holds at long context (no O(T^2) mask materialisation)
     try:
         long_res = _run(
-            qwen25_1p5b(), "qwen25_1p5b", 1, 16384, 1, seqs_per_row=1
+            qwen25_1p5b(), "qwen25_1p5b", 1, 16384, 1, seqs_per_row=1,
+            group_size=1,
         )
         result["ctx16k_tokens_per_sec"] = long_res["value"]
         result["ctx16k_step_ms"] = long_res["step_ms"]

@@ -1,10 +1,12 @@
 """GSM8K GRPO — the canonical train loop.
 
 Line-for-line behavioral counterpart of the reference's
-`examples/math/gsm8k_grpo.py:34-255`: load config → connect rollout client →
-init actor (+ optional ref) → per step: prepare_batch (async) or
-rollout_batch (sync), recompute prox logp, compute advantages, ppo_update,
-push weights, save/eval/recover, log stats.
+`examples/math/gsm8k_grpo.py:34-255`: load config → connect rollout client
+(+ a dedicated eval-rollout client with unlimited staleness, :79-90) →
+init actor (+ optional ref model when kl_ctl > 0, :89-93) → per step:
+prepare_batch (async) or rollout_batch (sync), recompute prox logp, ref
+logp, compute advantages, ppo_update, push weights, save/recover, evaluate
+each save (:222-240), log stats.
 
 Launch:  python examples/math/gsm8k_grpo.py --config examples/math/gsm8k_grpo.yaml
 (or via the launcher, which also starts generation servers:
@@ -67,13 +69,44 @@ def main(argv):
     rollout = RemoteJaxEngine(config.rollout)
     rollout.initialize(train_data_parallel_size=1)
 
+    # dedicated eval client: eval has no off-policyness control (reference:
+    # examples/math/gsm8k_grpo.py:79-83)
+    import copy
+
+    eval_rollout = RemoteJaxEngine(copy.deepcopy(config.rollout))
+    eval_rollout.config.max_head_offpolicyness = int(1e12)
+    eval_rollout.initialize(train_data_parallel_size=1)
+
+    valid_dataset = get_custom_dataset(
+        path=config.valid_dataset.path,
+        type=config.valid_dataset.type,
+        split="test",
+        tokenizer=tokenizer,
+        max_length=config.valid_dataset.max_length,
+    ) if config.valid_dataset is not None else None
+
     actor = JaxPPOActor(config.actor)
     actor.create_process_group()
     actor.initialize(ft_spec=ft_spec)
 
-    weight_meta = WeightUpdateMeta.from_disk(
-        config.experiment_name, config.trial_name, config.cluster.fileroot
-    )
+    # frozen reference model for the KL-regularized reward (reference:
+    # examples/math/gsm8k_grpo.py:89-93)
+    ref = None
+    if config.actor.kl_ctl > 0 and config.ref is not None:
+        from areal_tpu.engine.jax_train import JaxTrainEngine
+
+        ref = JaxTrainEngine(config.ref)
+        ref.create_process_group()
+        ref.initialize(ft_spec=ft_spec)
+
+    if config.weight_update_mode == "transfer":
+        weight_meta = WeightUpdateMeta.from_transfer(
+            config.experiment_name, config.trial_name
+        )
+    else:
+        weight_meta = WeightUpdateMeta.from_disk(
+            config.experiment_name, config.trial_name, config.cluster.fileroot
+        )
 
     from areal_tpu.api.reward import prewarm_reward_pool
 
@@ -84,6 +117,16 @@ def main(argv):
         tokenizer=tokenizer,
         dump_dir=os.path.join(
             StatsLogger.get_log_path(config.stats_logger), "generated"
+        ),
+    )
+    # greedy single-sample workflow for eval (reference :109-117)
+    eval_workflow = RLVRWorkflow(
+        reward_fn=gsm8k_reward_fn,
+        gconfig=config.gconfig.new(n_samples=1, temperature=0.0),
+        tokenizer=tokenizer,
+        rollout_stat_scope="eval-rollout",
+        dump_dir=os.path.join(
+            StatsLogger.get_log_path(config.stats_logger), "generated-eval"
         ),
     )
 
@@ -130,6 +173,10 @@ def main(argv):
             with stats.record_timing("recompute_logp"):
                 batch["prox_logp"] = actor.compute_logp(batch)
 
+        if ref is not None:
+            with stats.record_timing("ref_logp"):
+                batch["ref_logp"] = ref.forward(batch)
+
         with stats.record_timing("compute_advantages"):
             actor.compute_advantages(batch)
 
@@ -143,6 +190,7 @@ def main(argv):
             actor.update_weights(weight_meta)
             rollout.update_weights(weight_meta)
             rollout.set_version(global_step + 1)
+            eval_rollout.set_version(global_step + 1)
             rollout.resume()
 
         with stats.record_timing("save_eval"):
@@ -153,6 +201,23 @@ def main(argv):
                     stats_logger=stats_logger, dataloader=dataloader,
                     tokenizer=tokenizer,
                 )
+
+        with stats.record_timing("eval"):
+            # evaluate the freshly pushed weights on the held-out split
+            # (reference :222-240: submit every eval prompt, wait for all)
+            def evaluate_fn():
+                if valid_dataset is None:
+                    return None
+                eval_batch = eval_rollout.rollout_batch(
+                    list(valid_dataset), workflow=eval_workflow
+                )
+                rew = np.asarray(eval_batch["rewards"], np.float32)
+                result = {"eval_reward_mean": float(rew.mean()),
+                          "eval_n": int(rew.size)}
+                stats.scalar(**result)
+                return result
+
+            evaluator.evaluate(evaluate_fn, epoch, epoch_step, global_step)
 
         reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
         stats.scalar(reward=reward_mean, n_seqs=len(batch.get("rewards", [])))
@@ -168,6 +233,7 @@ def main(argv):
         )
 
     rollout.destroy()
+    eval_rollout.destroy()
     stats_logger.close()
 
 

@@ -152,3 +152,110 @@ def test_grpo_end_to_end_with_disk_weight_sync(tmp_path):
         rollout.destroy()
         server.shutdown.set()
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_grpo_transfer_weight_sync(tmp_path):
+    """Transfer (non-disk) weight sync: trainer streams bf16 chunks over
+    /update_weights_chunk and commits (VERDICT round-1 next-step #4).
+    Reports both paths' update latency."""
+    import jax
+
+    from areal_tpu.utils import name_resolve, names
+
+    ckpt0 = tmp_path / "init"
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    save_hf_checkpoint(params, CFG, str(ckpt0), save_dtype="float32")
+
+    engine = GenEngine(CFG.replace(dtype="float32"), model_path=str(ckpt0),
+                       n_slots=4, max_seq_len=96, prompt_bucket=16,
+                       decode_chunk=4)
+    server = GenServer(engine)
+    server.start()
+    port = network.find_free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.app())
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    import urllib.request
+
+    for _ in range(100):
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.1)
+
+    # register for trainer-side discovery (the launcher's job in real runs)
+    name_resolve.add(
+        names.gen_server("e2e-tr", "t", "0"), f"127.0.0.1:{port}", replace=True
+    )
+
+    actor = JaxPPOActor(
+        PPOActorConfig(
+            experiment_name="e2e-tr", trial_name="t", path=str(ckpt0),
+            dtype="float32", gradient_checkpointing=False,
+            mesh=MeshConfig(), mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+            pack_length_quantum=32, max_pack_length=96,
+            group_size=2, ppo_n_minibatches=1,
+        ),
+    )
+    actor.initialize(ft_spec=FinetuneSpec(1, 16, 4))
+
+    try:
+        # --- transfer path: chunk small enough to force multi-part arrays
+        meta_t = WeightUpdateMeta.from_transfer("e2e-tr", "t", chunk_mb=1)
+        actor.set_version(1)
+        t0 = time.perf_counter()
+        actor.update_weights(meta_t)
+        dt_transfer = time.perf_counter() - t0
+        assert engine.version == 1
+
+        # server now runs the trainer's weights: greedy outputs must match a
+        # local engine fed the same params (round-trip integrity)
+        local = GenEngine(CFG.replace(dtype="float32"),
+                          params=actor._host_params(), n_slots=1,
+                          max_seq_len=96, prompt_bucket=16)
+        from areal_tpu.gen.engine import GenRequest
+
+        prompt = [3, 1, 4, 1, 5]
+        r_local = GenRequest(rid="l", input_ids=list(prompt),
+                             max_new_tokens=6, temperature=0.0)
+        local.generate_blocking([r_local])
+        import json
+        import urllib.request as rq
+
+        req = rq.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({
+                "rid": "r", "input_ids": prompt,
+                "sampling_params": {"max_new_tokens": 6, "temperature": 0.0},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        remote = json.loads(rq.urlopen(req, timeout=60).read())
+        assert remote["output_tokens"] == r_local.output_tokens
+
+        # --- disk path for latency comparison (versioned atomic dirs)
+        weight_dir = tmp_path / "updates"
+        weight_dir.mkdir()
+        meta_d = WeightUpdateMeta(type="disk", path=str(weight_dir),
+                                  experiment_name="e2e-tr", trial_name="t")
+        actor.set_version(2)
+        t0 = time.perf_counter()
+        actor.update_weights(meta_d)
+        dt_disk_write = time.perf_counter() - t0
+        assert (weight_dir / "v2").is_dir()
+        v = engine.load_weights(path=str(weight_dir), version=2)
+        assert v == 2
+        print(f"update latency: transfer={dt_transfer*1e3:.0f}ms "
+              f"disk_write={dt_disk_write*1e3:.0f}ms")
+    finally:
+        server.shutdown.set()
+        loop.call_soon_threadsafe(loop.stop)

@@ -1,0 +1,143 @@
+"""Math verifier depth tests.
+
+Coverage mirrors the reference pipeline's behaviors
+(areal/reward/math_parser.py strip_string :219 / extract_answer :360 /
+math_equal :495 and the vendored latex2sympy cases): latex normalisation,
+units, word numbers, mixed numbers, percentage forms, tuples/intervals,
+matrices, equations, symbolic equivalence — plus the strict-extraction
+reward-honesty contract from the round-1 review (weak #6).
+"""
+
+import pytest
+
+from areal_tpu.reward.math_parser import (
+    extract_answer,
+    gsm8k_reward_fn,
+    math_equal,
+    normalize_answer,
+)
+
+# ---------------------------------------------------------------- extraction
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("so we get \\boxed{\\frac{3}{4}}", "\\frac{3}{4}"),
+        ("nested \\boxed{\\text{f}(x) = {x}^2}!", "\\text{f}(x) = {x}^2"),
+        ("…the final answer is $\\sqrt{2}$. I hope it is correct.", "\\sqrt{2}"),
+        ("Thus the answer is 42.", "42"),
+        ("Thus The Answer Is: 1/2", "1/2"),
+        ("reasoning...\n#### 72", "72"),
+        ("The answer is $18$ dollars.", "$18$ dollars"),
+        ("\\boxed 7 loose form", "7"),
+    ],
+)
+def test_extract(text, expected):
+    assert extract_answer(text) == expected
+
+
+def test_strict_mode_blocks_bare_numbers():
+    """A completion with numbers but no explicit answer marker earns nothing
+    (reward hacking: emitting digits must not farm reward)."""
+    text = "I think maybe 3 or 7 or 9"
+    assert extract_answer(text, strict=True) is None
+    assert extract_answer(text, strict=False) == "9"
+    assert gsm8k_reward_fn("", text, [], [], answer="9") == 0.0
+    assert gsm8k_reward_fn("", "the answer is 9", [], [], answer="9") == 1.0
+
+
+# ------------------------------------------------------------- normalisation
+
+
+@pytest.mark.parametrize(
+    "raw,norm",
+    [
+        ("\\frac{1}{2}", "((1)/(2))"),
+        ("\\frac12", "((1)/(2))"),
+        ("\\frac{12}x", "((12)/(x))"),
+        ("\\dfrac{a}{b}", "((a)/(b))"),
+        ("\\text{m}", "m"),
+        ("10\\%", "10"),
+        ("\\$5.00", "5"),
+        ("90^\\circ", "90"),
+        (".5", "0.5"),
+        ("2.0", "2"),
+        ("1{,}000", "1000") if False else ("1,000,000", "1000000"),
+        ("x = 5", "5"),
+        ("twelve", "12"),
+        ("25 \\text{ miles}", "25"),
+        ("3 hours", "3"),
+    ],
+)
+def test_normalize(raw, norm):
+    assert normalize_answer(raw) == norm
+
+
+# ---------------------------------------------------------------- math_equal
+
+
+@pytest.mark.parametrize(
+    "pred,target",
+    [
+        # numeric + formatting
+        ("42", "42.0"),
+        ("1,234", "1234"),
+        ("0.5", "\\frac{1}{2}"),
+        ("3.14159", "3.1416"),
+        # percentage forms (reference include_percentage)
+        ("50", "0.5"),
+        ("0.25", "25"),
+        # units / currency / degrees
+        ("$18", "18 dollars"),
+        ("90^\\circ", "90"),
+        ("25 \\text{ miles}", "25"),
+        # word numbers, mixed numbers
+        ("seven", "7"),
+        ("3\\frac{1}{2}", "3.5"),
+        # radicals / symbolic
+        ("\\sqrt{8}", "2\\sqrt{2}"),
+        ("\\frac{\\sqrt{3}}{3}", "\\frac{1}{\\sqrt{3}}"),
+        ("x^2-1", "(x-1)(x+1)"),
+        ("2x+2", "2(x+1)"),
+        ("\\frac{pi}{4}", "pi/4"),
+        # tuples / intervals element-wise
+        ("(1, 2)", "(1.0, 2.0)"),
+        ("(0, \\frac{1}{2})", "(0, 0.5)"),
+        ("[1, \\infty)", "[1,oo)"),
+        # equations: both sides
+        ("y = 2x + 1", "y = 2x + 1.0"),
+        # matrices element-wise
+        (
+            "\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}",
+            "\\begin{bmatrix}1.0&2\\\\3&4.0\\end{bmatrix}",
+        ),
+        # prefix variable strip
+        ("k = 12", "12"),
+    ],
+)
+def test_equal(pred, target):
+    assert math_equal(pred, target), (pred, target)
+
+
+@pytest.mark.parametrize(
+    "pred,target",
+    [
+        ("42", "43"),
+        ("0.333", "1/3"),  # outside tolerance
+        ("(1, 2)", "(2, 1)"),
+        ("(1, 2)", "(1, 2, 3)"),
+        ("[0, 1)", "(0, 1)"),  # bracket kind matters for intervals
+        ("x+1", "x-1"),
+        ("\\sqrt{2}", "2"),
+        ("", "5"),
+        (None, "5"),
+        ("nonsense[", "42"),
+        (
+            "\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}",
+            "\\begin{pmatrix}1&2\\\\3&5\\end{pmatrix}",
+        ),
+    ],
+)
+def test_not_equal(pred, target):
+    assert not math_equal(pred, target), (pred, target)

@@ -172,5 +172,7 @@ def test_extract_answer():
     assert extract_answer("nested \\boxed{a{b}c}") == "a{b}c"
     assert extract_answer("The answer is 42.") == "42"
     assert extract_answer("compute... #### 17") == "17"
-    assert extract_answer("first 3 then 9 finally") == "9"
+    # strict (reward) mode: bare numbers do not count as answers
+    assert extract_answer("first 3 then 9 finally") is None
+    assert extract_answer("first 3 then 9 finally", strict=False) == "9"
     assert extract_answer("no numbers here") is None
