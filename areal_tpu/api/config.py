@@ -232,19 +232,20 @@ class GenServerConfig:
         port: int,
         dist_init_addr: Optional[str] = None,
     ) -> str:
-        """Shell command launching a generation server (reference: SGLangConfig.build_cmd)."""
+        """Shell command launching a generation server (reference:
+        SGLangConfig.build_cmd); flags match gen/server.py's argparse —
+        launchers must use this instead of hand-building the command."""
+        import sys
+
         args = [
-            "python", "-m", "areal_tpu.gen.server",
+            sys.executable, "-m", "areal_tpu.gen.server",
             f"--model-path={config.model_path}",
-            f"--dtype={config.dtype}",
-            f"--max-seqs={config.max_seqs}",
-            f"--max-context-len={config.max_context_len}",
-            f"--host={host}",
-            f"--port={port}",
-            f"--random-seed={config.random_seed}",
+            f"--n-slots={config.max_seqs}",
+            f"--max-seq-len={config.max_context_len}",
+            f"--tp={max(1, config.mesh.tensor_parallel_size)}",
         ]
-        if dist_init_addr:
-            args.append(f"--dist-init-addr={dist_init_addr}")
+        if port:
+            args.append(f"--port={port}")
         return " ".join(args)
 
 
@@ -512,6 +513,13 @@ def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
         if is_dataclass(sub) and hasattr(sub, "fileroot"):
             if getattr(sub, "fileroot", None) in ("", None):
                 sub.fileroot = cfg.cluster.fileroot
+    # select the name_resolve backend for this process: the env override
+    # (set by multi-host launchers for every spawned process) wins over the
+    # config; both route through utils.name_resolve.reconfigure
+    if hasattr(cfg, "cluster"):
+        from areal_tpu.utils import name_resolve as _nr
+
+        _nr.reconfigure_from_env(cfg.cluster.name_resolve)
     return cfg, args.config or ""
 
 

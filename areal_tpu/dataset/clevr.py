@@ -1,0 +1,71 @@
+"""CLEVR-count visual RL dataset (reference: areal/dataset/clevr_count_70k.py
+get_clevr_count_70k_rl_dataset) + counting reward.
+
+Loads a jsonl manifest (offline-friendly — this environment has no network
+egress) or an HF dataset dir; each sample carries image paths/arrays, a
+counting question, and the integer answer.  Samples feed
+`VisionRLVRWorkflow` (workflow/vision_rlvr.py).
+"""
+
+import json
+import os
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+from areal_tpu.reward.math_parser import extract_answer
+
+
+@register_dataset("clevr")
+def get_clevr_count_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    processor=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    """jsonl manifest rows: {"images": [path...] | "image": path,
+    "messages": str | chat list, "answer": int}.  Image paths resolve
+    relative to the manifest; images load lazily in the workflow's
+    processor call."""
+    manifest = path
+    if os.path.isdir(path):
+        manifest = os.path.join(path, f"{split}.jsonl")
+    samples = []
+    base = os.path.dirname(os.path.abspath(manifest))
+    with open(manifest) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            images = row.get("images") or [row["image"]]
+            images = [
+                img if not isinstance(img, str) or os.path.isabs(img)
+                else os.path.join(base, img)
+                for img in images
+            ]
+            sample = {
+                "images": images,
+                "messages": row["messages"],
+                "answer": str(row["answer"]),
+                "query_id": str(row.get("query_id", i)),
+            }
+            if "input_ids" in row:
+                sample["input_ids"] = row["input_ids"]
+                if max_length and len(sample["input_ids"]) > max_length:
+                    continue
+            samples.append(sample)
+    return samples
+
+
+def clevr_count_reward(prompt, completions, prompt_ids, completion_ids,
+                       answer=None, **kw):
+    """1.0 iff the completion's explicitly-marked answer equals the count
+    (strict extraction: emitting stray digits earns nothing)."""
+    pred = extract_answer(completions, strict=True)
+    if pred is None or answer is None:
+        return 0.0
+    try:
+        return float(int(float(pred)) == int(float(str(answer))))
+    except ValueError:
+        return 0.0

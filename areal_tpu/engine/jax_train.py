@@ -123,6 +123,7 @@ class JaxTrainEngine(TrainEngine):
                 fsdp=m.fsdp_parallel_size,
                 sp=m.sequence_parallel_size,
                 tp=m.tensor_parallel_size,
+                ep=getattr(m, "expert_parallel_size", 1),
             )
         logger.info(f"mesh: {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}")
 
@@ -152,7 +153,20 @@ class JaxTrainEngine(TrainEngine):
             param_dtype=cfg.param_dtype,
             remat=cfg.gradient_checkpointing,
         )
-        specs = param_partition_specs(self.model_config)
+        if getattr(cfg, "lora", None) is not None and cfg.lora.enabled:
+            from areal_tpu.models.lora import add_lora_params
+
+            self.model_config = self.model_config.replace(
+                lora_rank=cfg.lora.rank,
+                lora_alpha=cfg.lora.alpha,
+                lora_targets=tuple(cfg.lora.target_modules),
+            )
+            host_params = add_lora_params(
+                host_params, self.model_config, jax.random.PRNGKey(1)
+            )
+        specs = param_partition_specs(
+            self.model_config, tp=self.mesh.shape["tp"]
+        )
         self.params = shard_pytree(self.mesh, host_params, specs)
 
         if cfg.optimizer is not None:
@@ -195,12 +209,31 @@ class JaxTrainEngine(TrainEngine):
                 mask=wd_mask,
             ),
         )
+        if self.model_config.lora_rank:
+            # adapters only: optax.masked keeps moment state solely for the
+            # adapter leaves — the memory point of LoRA (the base weights
+            # are already stop_gradient-frozen in the forward)
+            from areal_tpu.models.lora import trainable_mask
+
+            self._optimizer = optax.masked(
+                self._optimizer, trainable_mask(self.params)
+            )
         # Eager init: zeros_like inherits each param's NamedSharding for
-        # mu/nu, and scalar counters stay uncommitted (placeable by jit);
-        # a jitted init without out_shardings would commit everything to
-        # one device and clash with the sharded params inside train_step.
+        # mu/nu; scalar counters are explicitly replicated over the mesh so
+        # the compiled step sees one consistent device set (and so an orbax
+        # restore — which commits whatever it loads — matches too).
         with self.mesh:
             self.opt_state = self._optimizer.init(self.params)
+        self.opt_state = self._replicate_scalars(self.opt_state)
+
+    def _replicate_scalars(self, tree):
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rep)
+            if isinstance(x, jax.Array) and x.ndim == 0
+            else x,
+            tree,
+        )
 
     def destroy(self) -> None:
         self.params = None
@@ -243,8 +276,11 @@ class JaxTrainEngine(TrainEngine):
     ) -> Tuple[RowPackedBatch, Dict[str, np.ndarray], int]:
         """Row-pack a padded batch; rows divisible by n_mbs * dp * fsdp."""
         row_len = self._row_len(batch)
-        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
-        rp = pack_into_rows(batch, row_len, rows_multiple=n_mbs * dp)
+        dp = (self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+              * self.mesh.shape.get("ep", 1))
+        rp = pack_into_rows(
+            batch, row_len, rows_multiple=n_mbs * dp, rows_bucket_pow2=True
+        )
         data = dict(rp.data)
         data["input_ids"] = data["input_ids"].astype(np.int32)
         # filler rows/tokens must never contribute to the loss
@@ -512,6 +548,13 @@ class JaxTrainEngine(TrainEngine):
             lambda x: np.asarray(gather(x).addressable_data(0)), self.params
         )
 
+    def _export_params(self):
+        """Host params in served form: LoRA adapters folded into the base
+        (reference pushes merged weights, fsdp_engine.py:270)."""
+        from areal_tpu.models.lora import merge_lora
+
+        return merge_lora(self._host_params(), self.model_config)
+
     def update_weights(self, meta: WeightUpdateMeta) -> None:
         """Publish fresh weights to inference servers.
 
@@ -536,7 +579,7 @@ class JaxTrainEngine(TrainEngine):
         final = os.path.join(meta.path, f"v{self._version}")
         tmp = os.path.join(meta.path, f".tmp-v{self._version}-{os.getpid()}")
         if distributed.is_head():
-            host = self._host_params()
+            host = self._export_params()
             save_hf_checkpoint(
                 host,
                 self.model_config,
@@ -603,7 +646,7 @@ class JaxTrainEngine(TrainEngine):
         from areal_tpu.models.hf import params_to_hf_state
         from areal_tpu.utils.http import arequest_with_retry
 
-        host = self._host_params()
+        host = self._export_params()
         if not distributed.is_head():
             return
         addrs = self._server_addrs(meta)
@@ -653,47 +696,111 @@ class JaxTrainEngine(TrainEngine):
         asyncio.run(run())
 
     def save(self, meta: SaveLoadMeta) -> None:
+        """Model weights as an HF safetensors dir (interop with inference
+        servers and transformers); optimizer state via orbax/tensorstore —
+        sharded (each process writes only the shards it owns), structure-
+        checked on restore, and not tied to optax's leaf ordering the way
+        the old positional npz dump was (round-1 weak #5).
+
+        With LoRA: exports (with_optim=False) fold the adapters into the
+        base weights for downstream consumers; recover checkpoints
+        (with_optim=True) keep the base UNMERGED and persist the adapters
+        alongside the optimizer state so load() round-trips exactly."""
+        from areal_tpu.models.lora import split_lora
+
+        lora_on = self.model_config.lora_rank > 0
+        if meta.with_optim:
+            host, host_adapters = (
+                split_lora(self._host_params()) if lora_on
+                else (self._host_params(), None)
+            )
+        else:
+            host, host_adapters = self._export_params(), None
         save_hf_checkpoint(
-            self._host_params(),
+            host,
             self.model_config,
             meta.path,
             save_dtype="bfloat16" if not meta.with_optim else "float32",
             tokenizer_src=self.config.path or None,
         )
         if meta.with_optim and self.opt_state is not None:
-            leaves = jax.tree_util.tree_leaves(self.opt_state)
-            np.savez(
-                os.path.join(meta.path, "optimizer_state.npz"),
-                step=self.step_count,
-                **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
-            )
+            import orbax.checkpoint as ocp
+
+            state = {
+                "opt_state": self.opt_state,
+                "step": jnp.asarray(self.step_count, jnp.int32),
+            }
+            if host_adapters is not None:
+                state["lora"] = host_adapters
+            ckptr = ocp.StandardCheckpointer()
+            with self.mesh:
+                ckptr.save(
+                    os.path.abspath(os.path.join(meta.path, "optimizer_state")),
+                    state,
+                    force=True,
+                )
+                ckptr.wait_until_finished()
+            ckptr.close()
 
     def load(self, meta: SaveLoadMeta) -> None:
         host_params, mc = load_hf_params(
             meta.path, self.model_config, dtype=self.config.param_dtype
         )
+        lora_on = (
+            self.model_config is not None and self.model_config.lora_rank > 0
+        )
         self.model_config = mc.replace(
             dtype=self.config.dtype,
             param_dtype=self.config.param_dtype,
             remat=self.config.gradient_checkpointing,
+            lora_rank=self.model_config.lora_rank if lora_on else 0,
+            lora_alpha=self.model_config.lora_alpha,
+            lora_targets=self.model_config.lora_targets if lora_on else (),
         )
+        if lora_on:
+            from areal_tpu.models.lora import add_lora_params
+
+            host_params = add_lora_params(
+                host_params, self.model_config, jax.random.PRNGKey(1)
+            )
         self.params = shard_pytree(
-            self.mesh, host_params, param_partition_specs(self.model_config)
+            self.mesh,
+            host_params,
+            param_partition_specs(self.model_config, tp=self.mesh.shape["tp"]),
         )
-        opt_path = os.path.join(meta.path, "optimizer_state.npz")
-        if meta.with_optim and os.path.exists(opt_path):
-            saved = np.load(opt_path)
-            self.step_count = int(saved["step"])
-            live_leaves, treedef = jax.tree_util.tree_flatten(self.opt_state)
-            restored = []
-            for i, live in enumerate(live_leaves):
-                arr = jnp.asarray(saved[f"leaf_{i}"])
-                # shard like the live leaf; leave scalars uncommitted so jit
-                # can replicate them alongside any param sharding
-                if getattr(live, "ndim", 0) >= 1:
-                    arr = jax.device_put(arr, live.sharding)
-                restored.append(arr)
-            self.opt_state = jax.tree_util.tree_unflatten(treedef, restored)
+        opt_path = os.path.abspath(os.path.join(meta.path, "optimizer_state"))
+        if meta.with_optim and os.path.isdir(opt_path):
+            import orbax.checkpoint as ocp
+
+            from areal_tpu.models.lora import split_lora
+
+            template = {
+                "opt_state": self.opt_state,
+                "step": jnp.asarray(self.step_count, jnp.int32),
+            }
+            if lora_on:
+                # sharded live adapters as the template: orbax restores
+                # each process's shards in place (np.asarray would crash on
+                # multi-host global arrays)
+                template["lora"] = split_lora(self.params)[1]
+            ckptr = ocp.StandardCheckpointer()
+            with self.mesh:
+                # the live opt_state is the template: orbax restores each
+                # leaf with the matching sharding and validates structure
+                restored = ckptr.restore(opt_path, template)
+            ckptr.close()
+            self.opt_state = self._replicate_scalars(restored["opt_state"])
+            self.step_count = int(restored["step"])
+            if lora_on:
+                layers = dict(self.params["layers"])
+                for key, arr in restored["lora"].items():
+                    sub_name, leaf = key.split(".", 1)
+                    sub = dict(layers[sub_name])
+                    sub[leaf] = jax.device_put(
+                        arr, self.params["layers"][sub_name][leaf].sharding
+                    )
+                    layers[sub_name] = sub
+                self.params = {**self.params, "layers": layers}
 
     def step_lr_scheduler(self) -> None:
         # the schedule is step-indexed inside the jitted update; nothing to do

@@ -45,7 +45,8 @@ class JaxRewardModelEngine(JaxPPOCritic):
         mask = batch["attention_mask"].astype(bool)
         B, L = mask.shape
         row_len = self._row_len(batch)
-        dp = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        dp = (self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+              * self.mesh.shape.get("ep", 1))
         mult = n_mbs * dp * 2  # pairs must not straddle shard boundaries
         R = ((B + mult - 1) // mult) * mult
         lens = mask.sum(-1).astype(np.int32)

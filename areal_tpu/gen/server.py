@@ -273,6 +273,8 @@ def serve(
 
 
 def main():
+    # multi-host launchers point every process at a shared rendezvous store
+    name_resolve.reconfigure_from_env()
     p = argparse.ArgumentParser()
     p.add_argument("--model-path", default="")
     p.add_argument("--port", type=int, default=0)

@@ -1,0 +1,243 @@
+"""Multi-host launcher: gen servers + multi-process trainers across hosts.
+
+Behavioral counterpart of the reference's `RayLauncher` / `SlurmLauncher`
+(areal/launcher/ray.py:68, slurm.py:46): place generation servers on the
+inference hosts and one trainer process per training host, wire the
+rendezvous, babysit everything, and relaunch the whole run on failure with
+AREAL_RUN_ID incremented (the reference's recover loop).
+
+TPU-first differences:
+- No placement-group scheduler dependency: remote processes are started
+  over a pluggable `remote_shell` (ssh by default — TPU pods ship with
+  password-less ssh between workers; tests inject a local shell), which is
+  the role slurm's sbatch/srun plays for the reference.
+- Rendezvous is file-based: AREAL_NAME_RESOLVE=nfs:<root> points every
+  process at the shared-filesystem name_resolve store (gen servers register
+  their addresses; trainer clients discover them) and the trainer processes
+  join one jax.distributed runtime via the AREAL_COORDINATOR/NUM_PROCESSES/
+  PROCESS_ID contract (parallel/distributed.py) — collectives then ride
+  ICI/DCN with no launcher involvement.
+
+Usage:
+    python -m areal_tpu.launcher.multihost entry.py --config cfg.yaml \
+        [--gen-hosts h1,h2] [--train-hosts h3,h4] [k=v ...]
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.api.alloc import AllocationMode
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging, network
+
+logger = logging.getLogger("launcher.multihost")
+
+RECOVER_TIME_INTERVAL = 10.0
+COORDINATOR_PORT_BASE = 20000
+
+
+def ssh_shell(host: str, cmd: str, env: Dict[str, str], workdir: str) -> List[str]:
+    """Wrap a command for remote execution over ssh.
+
+    -tt forces a remote pty, so killing the local ssh client (stop_all)
+    delivers SIGHUP to the remote process tree — without it remote
+    trainers/servers would be orphaned and the recover relaunch would
+    collide with them over devices and name_resolve registrations."""
+    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+    remote = f"{exports} cd {shlex.quote(workdir)} && {cmd}"
+    return ["ssh", "-tt", "-o", "StrictHostKeyChecking=no", host, remote]
+
+
+def local_shell(host: str, cmd: str, env: Dict[str, str], workdir: str) -> List[str]:
+    """Run 'remote' commands locally — the 2-'host' test fabric (the
+    reference's testing.py trick of fabricating a cluster without one)."""
+    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+    return ["bash", "-c", f"{exports} cd {shlex.quote(workdir)} && {cmd}"]
+
+
+class MultiHostLauncher:
+    def __init__(
+        self,
+        entry: str,
+        config_args: List[str],
+        gen_hosts: List[str],
+        train_hosts: List[str],
+        remote_shell: Callable = ssh_shell,
+        workdir: Optional[str] = None,
+        coordinator_host: Optional[str] = None,
+    ):
+        self.entry = entry
+        self.config_args = config_args
+        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.gen_hosts = gen_hosts
+        self.train_hosts = train_hosts
+        self.remote_shell = remote_shell
+        self.workdir = workdir or os.getcwd()
+        # jax.distributed coordinator: process 0's host (tests fabricating
+        # hosts locally pass 127.0.0.1)
+        self.coordinator_host = coordinator_host or train_hosts[0]
+        self.procs: List[subprocess.Popen] = []
+        nr = self.config.cluster.name_resolve
+        if nr.type != "nfs":
+            raise ValueError(
+                "multi-host runs need a shared name_resolve store: set "
+                "cluster.name_resolve.type=nfs and nfs_record_root to a "
+                "path visible from every host"
+            )
+        self._nr_env = f"nfs:{nr.nfs_record_root}"
+
+    # ------------------------------------------------------------------
+
+    def _spawn(self, host: str, cmd: str, env: Dict[str, str], tag: str):
+        log_dir = os.path.join(
+            self.config.cluster.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "logs",
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        log_f = open(os.path.join(log_dir, f"{tag}.log"), "a")
+        env = {"AREAL_NAME_RESOLVE": self._nr_env, **env}
+        argv = self.remote_shell(host, cmd, env, self.workdir)
+        logger.info(f"spawn [{tag}] on {host}: {cmd}")
+        p = subprocess.Popen(
+            argv, stdout=log_f, stderr=subprocess.STDOUT, start_new_session=True
+        )
+        self.procs.append(p)
+        return p
+
+    def start_gen_servers(self) -> None:
+        """One server per gen host; each registers its address in the shared
+        name_resolve store (clients + the trainer's transfer path discover
+        them there)."""
+        from areal_tpu.api.config import GenServerConfig
+
+        g = self.config.gen_server
+        for idx, host in enumerate(self.gen_hosts):
+            cmd = (
+                GenServerConfig.build_cmd(g, host=host, port=0)
+                + f" --experiment-name {shlex.quote(self.config.experiment_name)}"
+                + f" --trial-name {shlex.quote(self.config.trial_name)}"
+                + f" --server-idx {idx}"
+            )
+            self._spawn(host, cmd, {}, tag=f"gen_server_{idx}")
+
+    def start_trainers(self, run_id: int) -> List[subprocess.Popen]:
+        """One trainer process per train host, all joining one
+        jax.distributed runtime; process 0 (on the first host) is the
+        coordinator and the DP head."""
+        n = len(self.train_hosts)
+        coordinator = f"{self.coordinator_host}:{COORDINATOR_PORT_BASE + run_id}"
+        cmd = f"{shlex.quote(sys.executable)} {shlex.quote(self.entry)} " + " ".join(
+            shlex.quote(a) for a in self.config_args
+        )
+        trainers = []
+        for pid, host in enumerate(self.train_hosts):
+            env = {
+                "AREAL_RUN_ID": str(run_id),
+                "AREAL_COORDINATOR": coordinator,
+                "AREAL_NUM_PROCESSES": str(n),
+                "AREAL_PROCESS_ID": str(pid),
+            }
+            trainers.append(
+                self._spawn(host, cmd, env, tag=f"trainer_p{pid}_run{run_id}")
+            )
+        return trainers
+
+    def stop_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        self.procs.clear()
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> int:
+        retries = max(1, self.config.recover.retries)
+        run_id = int(os.environ.get("AREAL_RUN_ID", 0))
+        rc = 1
+        try:
+            while run_id < retries:
+                self.start_gen_servers()
+                trainers = self.start_trainers(run_id)
+                rc = self._babysit(trainers)
+                self.stop_all()
+                if rc == 0:
+                    logger.info("all trainer processes finished successfully")
+                    return 0
+                run_id += 1
+                if run_id < retries and self.config.recover.mode in ("auto", "fault"):
+                    logger.warning(
+                        f"run failed rc={rc}; relaunching (run {run_id}) in "
+                        f"{RECOVER_TIME_INTERVAL}s"
+                    )
+                    time.sleep(RECOVER_TIME_INTERVAL)
+                else:
+                    break
+            return rc
+        finally:
+            self.stop_all()
+
+    def _babysit(self, trainers: List[subprocess.Popen]) -> int:
+        """Wait for every trainer; any trainer failure or gen-server death
+        fails the whole run (multi-process jax cannot lose a member)."""
+        while True:
+            codes = [t.poll() for t in trainers]
+            if any(c not in (None, 0) for c in codes):
+                return next(c for c in codes if c not in (None, 0))
+            if all(c == 0 for c in codes):
+                return 0
+            for p in self.procs:
+                if p not in trainers and p.poll() is not None:
+                    logger.error("a generation server died; restarting run")
+                    return 1
+            time.sleep(1.0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("entry")
+    parser.add_argument("--gen-hosts", default="",
+                        help="comma-separated hosts for generation servers")
+    parser.add_argument("--train-hosts", default="",
+                        help="comma-separated hosts for trainer processes")
+    args, config_args = parser.parse_known_args()
+    gen_hosts = [h for h in args.gen_hosts.split(",") if h]
+    train_hosts = [h for h in args.train_hosts.split(",") if h]
+    if not train_hosts:
+        parser.error("--train-hosts is required")
+    if not gen_hosts:
+        cfg, _ = load_expr_config(config_args, GRPOConfig)
+        alloc = (
+            AllocationMode.from_str(cfg.allocation_mode)
+            if cfg.allocation_mode
+            else None
+        )
+        n = max(1, alloc.gen.dp_size) if alloc and alloc.gen else 1
+        gen_hosts = train_hosts[:n]  # colocate by default
+    launcher = MultiHostLauncher(
+        args.entry, config_args, gen_hosts, train_hosts
+    )
+    sys.exit(launcher.run())
+
+
+if __name__ == "__main__":
+    main()

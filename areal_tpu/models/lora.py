@@ -1,0 +1,139 @@
+"""LoRA adapters for the functional transformer.
+
+Behavioral counterpart of the reference's PEFT integration
+(areal/engine/fsdp_engine.py:270-296: get_peft_model over target_modules,
+merged-weight push to inference).  TPU-first shape: adapters are extra
+leaves inside the layer-stacked pytree (`{w}_lora_a` [L, in, r],
+`{w}_lora_b` [L, r, out], B zero-initialised), so the layer scan, GSPMD
+sharding and orbax checkpointing all see ordinary arrays; base weights are
+frozen with stop_gradient (XLA then dead-code-eliminates their gradient
+computation) and the optimizer is `optax.masked` onto adapter leaves only —
+m/v state shrinks to adapter size, which is the memory point of LoRA.
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.model_config import TransformerConfig
+
+Params = Dict[str, Any]
+
+# HF-style target names -> (subtree, leaf) in the layer pytree
+TARGET_MAP = {
+    "q_proj": ("attn", "wq"),
+    "k_proj": ("attn", "wk"),
+    "v_proj": ("attn", "wv"),
+    "o_proj": ("attn", "wo"),
+    "gate_proj": ("mlp", "w_gate"),
+    "up_proj": ("mlp", "w_up"),
+    "down_proj": ("mlp", "w_down"),
+}
+
+
+def lora_scale(cfg: TransformerConfig) -> float:
+    return cfg.lora_alpha / max(cfg.lora_rank, 1)
+
+
+def add_lora_params(
+    params: Params, cfg: TransformerConfig, rng: jax.Array
+) -> Params:
+    """Attach adapter leaves next to each targeted base weight."""
+    r = cfg.lora_rank
+    pdt = jnp.dtype(cfg.param_dtype)
+    layers = dict(params["layers"])
+    for tgt in cfg.lora_targets:
+        sub, leaf = TARGET_MAP[tgt]
+        if sub not in layers:
+            continue  # e.g. mlp targets on an MoE model
+        tree = dict(layers[sub])
+        base = tree[leaf]  # [L, in, out]
+        L, d_in, d_out = base.shape
+        rng, ka = jax.random.split(rng)
+        tree[f"{leaf}_lora_a"] = (
+            jax.random.normal(ka, (L, d_in, r), jnp.float32) / np.sqrt(d_in)
+        ).astype(pdt)
+        tree[f"{leaf}_lora_b"] = jnp.zeros((L, r, d_out), pdt)
+        layers[sub] = tree
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def lora_delta(lp_sub: Params, leaf: str, x: jax.Array, dtype, scale: float):
+    """x @ A @ B * scale for one projection, or None if not adapted."""
+    a = lp_sub.get(f"{leaf}_lora_a")
+    if a is None:
+        return None
+    b = lp_sub[f"{leaf}_lora_b"]
+    down = jnp.einsum("btd,dr->btr", x, a.astype(dtype))
+    return jnp.einsum("btr,rh->bth", down, b.astype(dtype)) * dtype.type(scale)
+
+
+def freeze_base(params: Params, enabled: bool) -> Params:
+    """stop_gradient on every non-adapter leaf (no-op when LoRA is off):
+    XLA prunes the whole base backward pass."""
+    if not enabled:
+        return params
+
+    def _maybe(path, leaf):
+        name = path[-1].key if path else ""
+        return leaf if "_lora_" in str(name) else jax.lax.stop_gradient(leaf)
+
+    return jax.tree_util.tree_map_with_path(_maybe, params)
+
+
+def trainable_mask(params: Params) -> Params:
+    """True for adapter leaves — the optax.masked mask."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "_lora_" in str(path[-1].key if path else ""), params
+    )
+
+
+def merge_lora(host_params: Params, cfg: TransformerConfig) -> Params:
+    """Fold adapters into the base weights (numpy, host side) and drop the
+    adapter leaves — what gets pushed to inference servers / exported to HF
+    (reference: merged-weight upload, fsdp_engine.py:270)."""
+    if cfg.lora_rank <= 0:
+        return host_params
+    scale = lora_scale(cfg)
+    layers = dict(host_params["layers"])
+    for sub_name in list(layers):
+        sub = layers[sub_name]
+        if not isinstance(sub, dict):
+            continue
+        new_sub = {k: v for k, v in sub.items() if "_lora_" not in k}
+        for leaf in list(new_sub):
+            a = sub.get(f"{leaf}_lora_a")
+            if a is None:
+                continue
+            b = sub[f"{leaf}_lora_b"]
+            base = np.asarray(new_sub[leaf], np.float32)
+            delta = np.einsum("ldr,lrh->ldh", np.asarray(a, np.float32),
+                              np.asarray(b, np.float32)) * scale
+            new_sub[leaf] = (base + delta).astype(np.asarray(sub[leaf]).dtype)
+        layers[sub_name] = new_sub
+    out = dict(host_params)
+    out["layers"] = layers
+    return out
+
+
+def split_lora(params: Params) -> Tuple[Params, Params]:
+    """(base-only tree, adapters-only flat dict) for separate persistence."""
+    adapters = {}
+    layers = dict(params["layers"])
+    for sub_name, sub in list(layers.items()):
+        if not isinstance(sub, dict):
+            continue
+        keep = {}
+        for k, v in sub.items():
+            if "_lora_" in k:
+                adapters[f"{sub_name}.{k}"] = v
+            else:
+                keep[k] = v
+        layers[sub_name] = keep
+    base = dict(params)
+    base["layers"] = layers
+    return base, adapters

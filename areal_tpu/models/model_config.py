@@ -39,6 +39,13 @@ class TransformerConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_intermediate_size: Optional[int] = None
+    moe_capacity_factor: float = 1.25  # per-expert token budget multiplier
+    moe_aux_coef: float = 0.01  # Switch load-balance loss coefficient
+
+    # LoRA (0 = off); targets use HF module names (models/lora.py TARGET_MAP)
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ()
 
     # numerics
     dtype: str = "bfloat16"  # compute/activation dtype

@@ -78,6 +78,15 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _ffn(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
+    """Dense MLP or MoE block; returns (out, aux-loss scalar fp32)."""
+    if cfg.num_experts > 0:
+        from areal_tpu.models.moe import moe_ffn
+
+        return moe_ffn(cfg, lp["moe"], h, dtype)
+    return _mlp(lp, h, dtype, cfg), jnp.zeros((), jnp.float32)
+
+
 def _layer_forward(
     cfg: TransformerConfig,
     mesh: Optional[Mesh],
@@ -90,7 +99,7 @@ def _layer_forward(
     mask: Optional[jax.Array],  # [B, 1, T, T] — naive path only
 ):
     """One decoder block (cache-free; the generation paths below thread
-    their own cache through the same _qkv/_mlp primitives)."""
+    their own cache through the same _qkv/_ffn primitives)."""
     B, T, _ = x.shape
     dtype = x.dtype
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -112,21 +121,26 @@ def _layer_forward(
             mesh=mesh,
         )
     attn_out = attn_out.reshape(B, T, cfg.q_size)
-    x = x + jnp.einsum("bth,hd->btd", attn_out, lp["attn"]["wo"].astype(dtype))
+    x = x + _proj(cfg, lp["attn"], "wo", attn_out, dtype)
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-    return x + _mlp(lp, h, dtype), None
+    ffn_out, aux = _ffn(cfg, lp, h, dtype)
+    return x + ffn_out, aux
 
 
-def forward_hidden(
+def _backbone(
     params: Params,
     cfg: TransformerConfig,
-    input_ids: jax.Array,  # int32 [B, T]
-    positions: jax.Array,  # int32 [B, T]
-    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    input_ids: jax.Array,
+    positions: jax.Array,
+    segment_ids: jax.Array,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """Backbone forward -> final-norm hidden states [B, T, D] (for value /
-    reward heads, the role of the reference's critic models)."""
+):
+    """Layer scan -> (final-norm hidden [B, T, D], summed MoE aux loss)."""
+    if cfg.lora_rank:
+        # freeze everything but the adapters: XLA prunes the base bwd pass
+        from areal_tpu.models.lora import freeze_base
+
+        params = freeze_base(params, True)
     dtype = jnp.dtype(cfg.dtype)
     x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
@@ -147,12 +161,29 @@ def forward_hidden(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    def scan_body(x, lp):
-        x, _ = layer_fn(lp, x, cos, sin, segment_ids, positions, mask)
-        return x, None
+    def scan_body(carry, lp):
+        x, aux_sum = carry
+        x, aux = layer_fn(lp, x, cos, sin, segment_ids, positions, mask)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    (x, aux), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+    )
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), aux
+
+
+def forward_hidden(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,  # int32 [B, T]
+    positions: jax.Array,  # int32 [B, T]
+    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Backbone forward -> final-norm hidden states [B, T, D] (for value /
+    reward heads, the role of the reference's critic models)."""
+    x, _ = _backbone(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
+    return x
 
 
 def forward(
@@ -180,10 +211,14 @@ class LMOutput(NamedTuple):
     [tokens, vocab] matrix (2.4 GB bf16 / 4.9 GB fp32 at 8k tokens on a 151k
     vocab — the round-1 OOM wall) only ever exists one chunk at a time inside
     `ops.functional.lm_logprobs_entropy`'s rematerialised scan.
+
+    `aux_loss` carries the MoE load-balancing penalty (already scaled by
+    cfg.moe_aux_coef; 0 for dense models) — losses fold it in per token.
     """
 
     hidden: jax.Array  # [B, T, D] in compute dtype
     head: jax.Array  # [D, V] in compute dtype
+    aux_loss: Optional[jax.Array] = None  # scalar fp32
 
 
 def forward_lm(
@@ -196,11 +231,17 @@ def forward_lm(
 ) -> LMOutput:
     """Backbone forward with a *deferred* LM head (see LMOutput)."""
     dtype = jnp.dtype(cfg.dtype)
-    x = forward_hidden(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
+    x, aux = _backbone(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
-    return LMOutput(hidden=x, head=head.astype(dtype))
+    if cfg.lora_rank:
+        head = jax.lax.stop_gradient(head)
+    return LMOutput(
+        hidden=x,
+        head=head.astype(dtype),
+        aux_loss=aux * cfg.moe_aux_coef if cfg.num_experts > 0 else None,
+    )
 
 
 def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax.Array]):
@@ -224,10 +265,22 @@ def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax
 # decode advances every slot by exactly one token.
 
 
+def _proj(cfg: TransformerConfig, sub: Params, leaf: str, x: jax.Array, dtype):
+    """x @ W (+ LoRA delta when the leaf is adapted)."""
+    out = jnp.einsum("btd,dh->bth", x, sub[leaf].astype(dtype))
+    if cfg.lora_rank:
+        from areal_tpu.models.lora import lora_delta, lora_scale
+
+        d = lora_delta(sub, leaf, x, dtype, lora_scale(cfg))
+        if d is not None:
+            out = out + d
+    return out
+
+
 def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
-    q = jnp.einsum("btd,dh->bth", h, lp["attn"]["wq"].astype(dtype))
-    k = jnp.einsum("btd,dh->bth", h, lp["attn"]["wk"].astype(dtype))
-    v = jnp.einsum("btd,dh->bth", h, lp["attn"]["wv"].astype(dtype))
+    q = _proj(cfg, lp["attn"], "wq", h, dtype)
+    k = _proj(cfg, lp["attn"], "wk", h, dtype)
+    v = _proj(cfg, lp["attn"], "wv", h, dtype)
     if cfg.qkv_bias:
         q = q + lp["attn"]["bq"].astype(dtype)
         k = k + lp["attn"]["bk"].astype(dtype)
@@ -242,7 +295,11 @@ def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
     return q, k, v
 
 
-def _mlp(lp: Params, h: jax.Array, dtype):
+def _mlp(lp: Params, h: jax.Array, dtype, cfg: Optional[TransformerConfig] = None):
+    if cfg is not None and cfg.lora_rank:
+        gate = _proj(cfg, lp["mlp"], "w_gate", h, dtype)
+        up = _proj(cfg, lp["mlp"], "w_up", h, dtype)
+        return _proj(cfg, lp["mlp"], "w_down", jax.nn.silu(gate) * up, dtype)
     gate = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_gate"].astype(dtype))
     up = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_up"].astype(dtype))
     return jnp.einsum(
@@ -289,11 +346,9 @@ def forward_prefill(
         ck = ck.at[slot_ids, :P].set(k.astype(ck.dtype))
         cv = cv.at[slot_ids, :P].set(v.astype(cv.dtype))
         attn = attention(q, k, v, mask, cfg.attn_logit_softcap)
-        x = x + jnp.einsum(
-            "bth,hd->btd", attn.reshape(S, P, cfg.q_size), lp["attn"]["wo"].astype(dtype)
-        )
+        x = x + _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
         h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h, dtype)
+        x = x + _ffn(cfg, lp, h, dtype)[0]
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -343,11 +398,9 @@ def forward_decode(
         attn = attention(
             q, ck.astype(dtype), cv.astype(dtype), attn_mask, cfg.attn_logit_softcap
         )
-        x = x + jnp.einsum(
-            "bth,hd->btd", attn.reshape(S, 1, cfg.q_size), lp["attn"]["wo"].astype(dtype)
-        )
+        x = x + _proj(cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype)
         h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h, dtype)
+        x = x + _ffn(cfg, lp, h, dtype)[0]
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -383,14 +436,24 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
             "wv": dense(keys[2], (L, D, Hkv), D),
             "wo": dense(keys[3], (L, Hq, D), Hq),
         },
-        "mlp": {
-            "w_gate": dense(keys[4], (L, D, F), D),
-            "w_up": dense(keys[5], (L, D, F), D),
-            "w_down": dense(keys[6], (L, F, D), F),
-        },
         "input_norm": jnp.ones((L, D), pdt),
         "post_attn_norm": jnp.ones((L, D), pdt),
     }
+    if cfg.num_experts > 0:
+        E = cfg.num_experts
+        Fm = cfg.moe_intermediate_size or F
+        layers["moe"] = {
+            "router": dense(jax.random.fold_in(keys[4], 7), (L, D, E), D),
+            "w_gate": dense(keys[4], (L, E, D, Fm), D),
+            "w_up": dense(keys[5], (L, E, D, Fm), D),
+            "w_down": dense(keys[6], (L, E, Fm, D), Fm),
+        }
+    else:
+        layers["mlp"] = {
+            "w_gate": dense(keys[4], (L, D, F), D),
+            "w_up": dense(keys[5], (L, D, F), D),
+            "w_down": dense(keys[6], (L, F, D), F),
+        }
     if cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, Hq), pdt)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv), pdt)
@@ -431,15 +494,47 @@ def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
         attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
     if cfg.qk_norm:
         attn.update(q_norm=P(None, None), k_norm=P(None, None))
-    specs: Params = {
-        "embedding": P(vocab_axis, "fsdp"),
-        "layers": {
-            "attn": attn,
+    if cfg.num_experts > 0:
+        # experts over ep, megatron column/row split inside each expert —
+        # the reference's EP x ETP layout (alloc_mode.py:80-117)
+        ffn = {
+            "moe": {
+                "router": P(None, "fsdp", None),
+                "w_gate": P(None, "ep", "fsdp", "tp"),
+                "w_up": P(None, "ep", "fsdp", "tp"),
+                "w_down": P(None, "ep", "tp", "fsdp"),
+            }
+        }
+    else:
+        ffn = {
             "mlp": {
                 "w_gate": P(None, "fsdp", "tp"),
                 "w_up": P(None, "fsdp", "tp"),
                 "w_down": P(None, "tp", "fsdp"),
-            },
+            }
+        }
+    if cfg.lora_rank:
+        # adapters: A follows the base weight's input sharding, B its
+        # output (column/row) split; the rank dim stays whole
+        from areal_tpu.models.lora import TARGET_MAP
+
+        row_split = {"wo", "w_down"}
+        for tgt in cfg.lora_targets:
+            sub_name, leaf = TARGET_MAP[tgt]
+            sub = attn if sub_name == "attn" else ffn.get("mlp")
+            if sub is None or leaf not in sub:
+                continue
+            if leaf in row_split:
+                sub[f"{leaf}_lora_a"] = P(None, "tp", None)
+                sub[f"{leaf}_lora_b"] = P(None, None, "fsdp")
+            else:
+                sub[f"{leaf}_lora_a"] = P(None, "fsdp", None)
+                sub[f"{leaf}_lora_b"] = P(None, None, "tp")
+    specs: Params = {
+        "embedding": P(vocab_axis, "fsdp"),
+        "layers": {
+            "attn": attn,
+            **ffn,
             "input_norm": P(None, "fsdp"),
             "post_attn_norm": P(None, "fsdp"),
         },

@@ -219,7 +219,7 @@ def segment_attention(
 
     group = Hq // Hkv
     segment_ids = segment_ids.astype(jnp.int32)
-    if mesh is None or all(mesh.shape[a] == 1 for a in ("dp", "fsdp", "sp", "tp")):
+    if mesh is None or all(s == 1 for s in mesh.shape.values()):
         kernel = _make_kernel(
             T, group, sliding_window, logit_softcap, 1, interpret=INTERPRET
         )
@@ -245,7 +245,7 @@ def _sharded_splash(
     kernel_spec = kernel.manual_sharding_spec(
         NamedSharding(mesh, P(None, "sp"))  # (head, q_seq) mask-info layout
     )
-    batch = ("dp", "fsdp")
+    batch = ("dp", "fsdp", "ep")
 
     def body(kern, qs, ks, vs, seg_q, seg_kv):
         def per_row(qr, kr, vr, sq, skv):

@@ -253,6 +253,12 @@ def grpo_loss_fn(
     )
     if entropy_coef:
         loss = loss - entropy_coef * jnp.sum(entropy * loss_mask)
+    aux = getattr(model_out, "aux_loss", None)
+    if aux is not None:
+        # MoE load-balance penalty, weighted per valid token so the global
+        # loss normalisation leaves it as an average across micro-batches
+        loss = loss + aux * jnp.sum(loss_mask)
+        stats["moe_aux_loss"] = aux * jnp.sum(loss_mask)
     stats["entropy"] = jnp.sum(entropy * loss_mask)
     stats["new_logp"] = jnp.sum(logprobs * loss_mask)
     stats["old_logp"] = jnp.sum(old_logp * loss_mask)
@@ -297,6 +303,9 @@ def sft_loss_fn(
     mask = batch["loss_mask"].astype(jnp.float32)
     logprobs, _, correct = lm_logprobs_entropy(model_out, labels)
     loss = -jnp.sum(logprobs * mask)
+    aux = getattr(model_out, "aux_loss", None)
+    if aux is not None:
+        loss = loss + aux * jnp.sum(mask)
     return loss, {
         "loss_sum": loss,
         "n_valid_tokens": jnp.sum(mask),

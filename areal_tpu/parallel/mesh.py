@@ -15,6 +15,10 @@ Axis semantics:
   inserts the head/seq all-to-alls the reference hand-writes in
   areal/utils/ulysses.py)
 - tp: tensor parallel (megatron column/row split via the model's specs)
+- ep: expert parallel (MoE expert dim; the reference's
+  expert_parallel_size, alloc_mode.py:80-117 / megatron EP groups) — the
+  ep axis also carries batch rows when dense layers run, so ep chips are
+  never idle outside MoE blocks
 """
 
 from typing import Any, Optional, Sequence
@@ -26,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from areal_tpu.api.alloc import ParallelStrategy
 
-MeshAxes = ("dp", "fsdp", "sp", "tp")
+MeshAxes = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 def build_mesh(
@@ -34,16 +38,17 @@ def build_mesh(
     fsdp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[Any]] = None,
 ) -> Mesh:
-    """Build the 4-axis mesh. Axis order puts tp innermost so tensor-parallel
+    """Build the 5-axis mesh. Axis order puts tp innermost so tensor-parallel
     collectives ride the fastest ICI links."""
     if devices is None:
         devices = jax.devices()
-    need = dp * fsdp * sp * tp
+    need = dp * fsdp * sp * tp * ep
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    dev = np.asarray(devices[:need]).reshape(dp, fsdp, sp, tp)
+    dev = np.asarray(devices[:need]).reshape(dp, fsdp, ep, sp, tp)
     return Mesh(dev, MeshAxes)
 
 
@@ -67,16 +72,19 @@ def mesh_from_alloc(
         fsdp=strategy.fsdp_parallel_size,
         sp=sp,
         tp=strategy.tensor_parallel_size,
+        ep=strategy.expert_parallel_size,
         devices=devices,
     )
 
 
 def batch_spec(per_token: bool = True) -> P:
-    """PartitionSpec for [R, L(, ...)] batch arrays: rows over (dp, fsdp),
-    sequence over sp."""
+    """PartitionSpec for [R, L(, ...)] batch arrays: rows over
+    (dp, fsdp, ep) — ep chips carry rows through the dense layers and
+    exchange tokens for expert compute inside the MoE block — sequence
+    over sp."""
     if per_token:
-        return P(("dp", "fsdp"), "sp")
-    return P(("dp", "fsdp"))
+        return P(("dp", "fsdp", "ep"), "sp")
+    return P(("dp", "fsdp", "ep"))
 
 
 def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:

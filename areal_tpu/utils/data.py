@@ -438,12 +438,17 @@ def pack_into_rows(
     batch: Dict[str, np.ndarray],
     row_len: int,
     rows_multiple: int = 1,
+    rows_bucket_pow2: bool = False,
 ) -> RowPackedBatch:
     """Padded [B, L] batch -> RowPackedBatch.
 
     First-fit-decreasing over rows of capacity `row_len` (the balancing role
     of the reference's ffd_allocate, datapack.py); the row count is padded up
     to a multiple of `rows_multiple` (dp-shard divisibility) with empty rows.
+    With `rows_bucket_pow2` the count is additionally rounded to the next
+    power-of-two multiple, so the (row_len, rows) shape signature — and
+    therefore the number of compiled step programs — stays logarithmic in
+    batch-size variation.
     """
     mask = batch["attention_mask"].astype(bool)
     B, L = mask.shape
@@ -472,6 +477,10 @@ def pack_into_rows(
     R = max(1, len(rows))
     if rows_multiple > 1:
         R = ((R + rows_multiple - 1) // rows_multiple) * rows_multiple
+    if rows_bucket_pow2:
+        mult = max(rows_multiple, 1)
+        k = 1 << max(0, (R // mult) - 1).bit_length()  # next pow2 of R/mult
+        R = k * mult
     while len(rows) < R:
         rows.append([])
 

@@ -1,0 +1,51 @@
+"""Image wire helpers (reference: areal/utils/image.py image2base64)."""
+
+import base64
+import io
+from typing import Any, List, Union
+
+
+def image2base64(images: Union[Any, List[Any]]) -> List[str]:
+    """PIL images (or numpy arrays) -> base64-encoded PNG strings, the wire
+    format ModelRequest.image_data carries to inference servers."""
+    if not isinstance(images, (list, tuple)):
+        images = [images]
+    out = []
+    for img in images:
+        if isinstance(img, (bytes, bytearray)):
+            out.append(base64.b64encode(bytes(img)).decode())
+            continue
+        if hasattr(img, "save"):  # PIL
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            out.append(base64.b64encode(buf.getvalue()).decode())
+            continue
+        import numpy as np
+
+        arr = np.asarray(img)
+        try:
+            from PIL import Image
+
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            out.append(base64.b64encode(buf.getvalue()).decode())
+        except ImportError:  # raw bytes fallback
+            out.append(base64.b64encode(arr.tobytes()).decode())
+    return out
+
+
+def load_images(images: Union[Any, List[Any]]) -> List[Any]:
+    """Resolve dataset image entries — file paths, PIL images, arrays — to
+    in-memory images (paths are what the CLEVR manifest carries)."""
+    if not isinstance(images, (list, tuple)):
+        images = [images]
+    out = []
+    for img in images:
+        if isinstance(img, str):
+            from PIL import Image
+
+            with Image.open(img) as f:
+                out.append(f.convert("RGB").copy())
+        else:
+            out.append(img)
+    return out

@@ -1,0 +1,58 @@
+"""Multimodal rotary position ids (qwen2-VL mrope convention).
+
+Counterpart of the position-id preparation the reference does per batch for
+vision models (areal/engine/base_hf_engine.py:261-287, delegating to
+Qwen2VL's get_rope_index): three position channels (temporal, height,
+width).  Text tokens advance all three channels together; each image's
+tokens get (t, h, w) grid coordinates offset from the current position, and
+text resumes after the largest extent of the grid.
+"""
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def mrope_position_ids(
+    input_ids: Sequence[int],
+    image_token_id: int,
+    image_grid_thw: List[Tuple[int, int, int]],
+) -> np.ndarray:
+    """-> int32 [3, L] (temporal, height, width) position channels.
+
+    `image_grid_thw` lists each image's (t, h, w) token grid in the order
+    the images' placeholder runs appear in `input_ids`; the i-th contiguous
+    run of `image_token_id` must have length t*h*w.
+    """
+    ids = np.asarray(input_ids)
+    L = len(ids)
+    out = np.zeros((3, L), np.int32)
+    pos = 0
+    img_idx = 0
+    i = 0
+    while i < L:
+        if ids[i] == image_token_id:
+            if img_idx >= len(image_grid_thw):
+                raise ValueError("more image-token runs than image grids")
+            t, h, w = image_grid_thw[img_idx]
+            n = t * h * w
+            if i + n > L or not np.all(ids[i : i + n] == image_token_id):
+                raise ValueError(
+                    f"image-token run {img_idx} shorter than grid {t}x{h}x{w}"
+                )
+            grid_t, grid_h, grid_w = np.meshgrid(
+                np.arange(t), np.arange(h), np.arange(w), indexing="ij"
+            )
+            out[0, i : i + n] = pos + grid_t.reshape(-1)
+            out[1, i : i + n] = pos + grid_h.reshape(-1)
+            out[2, i : i + n] = pos + grid_w.reshape(-1)
+            pos += int(max(t, h, w))
+            i += n
+            img_idx += 1
+        else:
+            out[:, i] = pos
+            pos += 1
+            i += 1
+    if img_idx != len(image_grid_thw):
+        raise ValueError("fewer image-token runs than image grids")
+    return out

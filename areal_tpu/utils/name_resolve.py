@@ -298,6 +298,20 @@ def reconfigure(config: NameResolveConfig):
         raise ValueError(f"unknown name_resolve backend {config.type!r}")
 
 
+def reconfigure_from_env(fallback: "NameResolveConfig" = None):
+    """Pick the backend from AREAL_NAME_RESOLVE ("memory" | "nfs:<root>"),
+    falling back to the given config.  Launchers set the env var so every
+    spawned process (gen servers, trainers on other hosts) rendezvouses in
+    the same store."""
+    spec = os.environ.get("AREAL_NAME_RESOLVE", "")
+    if spec.startswith("nfs:"):
+        reconfigure(NameResolveConfig(type="nfs", nfs_record_root=spec[4:]))
+    elif spec == "memory":
+        reconfigure(NameResolveConfig(type="memory"))
+    elif fallback is not None and fallback.type != "memory":
+        reconfigure(fallback)
+
+
 def add(name, value, **kwargs):
     return DEFAULT_REPOSITORY.add(name, value, **kwargs)
 

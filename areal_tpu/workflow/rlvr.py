@@ -56,15 +56,22 @@ class RLVRWorkflow(RolloutWorkflow):
             )
         return self.tokenizer.encode(data["prompt"])
 
-    async def arun_episode(self, engine, data: Dict[str, Any]):
-        input_ids = self._tokenize_prompt(data)
-        n = self.gconfig.n_samples
-        req = ModelRequest(
+    def _build_request(self, data: Dict[str, Any]) -> ModelRequest:
+        """Hook: subclasses (vision) add modality payloads to the request."""
+        return ModelRequest(
             rid=str(uuid.uuid4()),
-            input_ids=input_ids,
+            input_ids=self._tokenize_prompt(data),
             gconfig=self.gconfig.new(n_samples=1),
             tokenizer=self.tokenizer,
         )
+
+    def _reward_kwargs(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        """Hook: subclasses filter non-picklable/heavy fields (images)."""
+        return data
+
+    async def arun_episode(self, engine, data: Dict[str, Any]):
+        n = self.gconfig.n_samples
+        req = self._build_request(data)
         resps = await asyncio.gather(
             *[engine.agenerate(req.copy()) for _ in range(n)]
         )
@@ -85,7 +92,7 @@ class RLVRWorkflow(RolloutWorkflow):
                 completion_str,
                 resp.input_tokens,
                 resp.output_tokens,
-                **data,
+                **self._reward_kwargs(data),
             )
             seq = resp.input_tokens + resp.output_tokens
             logprobs = [0.0] * resp.input_len + resp.output_logprobs

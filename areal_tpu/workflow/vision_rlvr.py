@@ -1,0 +1,71 @@
+"""Vision RLVR rollout workflow.
+
+Behavioral counterpart of the reference's `VisionRLVRWorkflow`
+(areal/workflow/vision_rlvr.py): episodes whose data carries `images` +
+`messages`; an HF AutoProcessor turns (images, text) into input_ids with
+image-placeholder tokens, the images travel to the inference server as
+base64 in `ModelRequest.image_data`, and rewards are computed from the
+decoded completion as in text RLVR (episode loop shared with RLVRWorkflow
+via the request/reward hooks).
+
+Serving note: the in-repo JAX generation engine is text-only today — this
+workflow targets inference backends that accept image_data (the backend
+protocol field is plumbed end-to-end); multimodal towers are the remaining
+model-side work.
+"""
+
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.utils.image import image2base64, load_images
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+
+class VisionRLVRWorkflow(RLVRWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        processor=None,
+        enable_thinking: bool = False,
+        rollout_stat_scope: str = "rollout",
+        dump_dir: Optional[str] = None,
+    ):
+        super().__init__(
+            reward_fn,
+            gconfig,
+            tokenizer=tokenizer,
+            enable_thinking=enable_thinking,
+            rollout_stat_scope=rollout_stat_scope,
+            dump_dir=dump_dir,
+        )
+        self.processor = processor
+
+    def _build_request(self, data: Dict[str, Any]) -> ModelRequest:
+        images = load_images(data["images"]) if "images" in data else None
+        if "input_ids" in data:
+            input_ids = list(data["input_ids"])
+        else:
+            if self.processor is None:
+                raise ValueError(
+                    "need an AutoProcessor or pre-tokenized input_ids"
+                )
+            processed = self.processor(
+                images=images, text=data["messages"], padding=False
+            )
+            ids = processed["input_ids"]
+            input_ids = list(ids[0] if hasattr(ids[0], "__len__") else ids)
+        return ModelRequest(
+            rid=str(uuid.uuid4()),
+            input_ids=input_ids,
+            image_data=image2base64(images) if images is not None else None,
+            gconfig=self.gconfig.new(n_samples=1),
+            tokenizer=self.tokenizer,
+            processor=self.processor,
+        )
+
+    def _reward_kwargs(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: v for k, v in data.items() if k != "images"}

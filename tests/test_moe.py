@@ -1,0 +1,140 @@
+"""MoE block + expert-parallel mesh tests (VERDICT round-1 next-step #10).
+
+Coverage model: the reference's MoE stack (realhf/impl/model/modules/moe/,
+Megatron EP in megatron_engine.py) — here the GShard-style dense-dispatch
+block (models/moe.py) and the `ep` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models import forward_lm, init_params
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.models.moe import expert_capacity, moe_ffn
+from areal_tpu.models.transformer import _mlp
+
+
+def _moe_cfg(**kw):
+    base = dict(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=2,
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_capacity_factor=4.0,  # ample capacity: no token dropping
+        dtype="float32",
+    )
+    base.update(kw)
+    return tiny_config(**base)
+
+
+def test_identical_experts_match_dense_mlp():
+    """With every expert = the same dense MLP and ample capacity, routing is
+    irrelevant: MoE output must equal the dense block exactly."""
+    cfg = _moe_cfg()
+    rng = np.random.default_rng(0)
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    w_gate = jnp.asarray(rng.normal(0, 0.05, (D, F)), jnp.float32)
+    w_up = jnp.asarray(rng.normal(0, 0.05, (D, F)), jnp.float32)
+    w_down = jnp.asarray(rng.normal(0, 0.05, (F, D)), jnp.float32)
+    E = cfg.num_experts
+    lp = {
+        "router": jnp.asarray(rng.normal(0, 1.0, (D, E)), jnp.float32),
+        "w_gate": jnp.broadcast_to(w_gate, (E, D, F)),
+        "w_up": jnp.broadcast_to(w_up, (E, D, F)),
+        "w_down": jnp.broadcast_to(w_down, (E, F, D)),
+    }
+    h = jnp.asarray(rng.normal(size=(2, 16, D)), jnp.float32)
+    out, aux = moe_ffn(cfg, lp, h, jnp.float32)
+    dense = _mlp({"mlp": {"w_gate": w_gate, "w_up": w_up, "w_down": w_down}},
+                 h, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+    # balanced-ish routing keeps the Switch aux loss near its floor of 1.0
+    assert 0.9 < float(aux) < 4.0
+
+
+def test_capacity_drops_tokens():
+    """With capacity 8 and every token routed to one expert, overflow tokens
+    contribute nothing (their combine weights are zero)."""
+    cfg = _moe_cfg(num_experts_per_tok=1, moe_capacity_factor=0.01)
+    rng = np.random.default_rng(1)
+    D, F, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    router = np.zeros((D, E), np.float32)
+    lp = {
+        "router": jnp.asarray(router),
+        "w_gate": jnp.asarray(rng.normal(0, 0.05, (E, D, F)), jnp.float32),
+        "w_up": jnp.asarray(rng.normal(0, 0.05, (E, D, F)), jnp.float32),
+        "w_down": jnp.asarray(rng.normal(0, 0.05, (E, F, D)), jnp.float32),
+    }
+    h = jnp.asarray(rng.normal(size=(1, 64, D)), jnp.float32)
+    out, _ = moe_ffn(cfg, lp, h, jnp.float32)
+    # zero router logits tie-break to expert 0 for every token; capacity is
+    # 8 so at most 8 token outputs are nonzero
+    nonzero_rows = np.abs(np.asarray(out)[0]).sum(-1) > 1e-9
+    assert nonzero_rows.sum() == expert_capacity(64, E, 1, 0.01)
+
+
+def test_moe_model_trains_on_ep_mesh():
+    """Full MoE model: forward_lm carries the aux loss, gradients flow, and
+    a PPO update runs on a dp2 x ep2 x tp2 mesh (expert dim sharded)."""
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.ppo import JaxPPOActor
+
+    cfg = PPOActorConfig(
+        experiment_name="moe", trial_name="t", init_from_scratch=True,
+        dtype="float32", param_dtype="float32", gradient_checkpointing=True,
+        mesh=MeshConfig(
+            data_parallel_size=2, expert_parallel_size=2,
+            tensor_parallel_size=2,
+        ),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+        optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        pack_length_quantum=32, max_pack_length=64,
+        group_size=2, ppo_n_minibatches=1,
+        adv_norm=NormConfig(mean_level="group", std_level="group", group_size=2),
+    )
+    actor = JaxPPOActor(cfg, model_config=_moe_cfg())
+    actor.initialize(ft_spec=FinetuneSpec(1, 16, 4))
+    assert actor.mesh.shape["ep"] == 2
+
+    rng = np.random.default_rng(2)
+    B, L = 8, 24
+    batch = {
+        "input_ids": rng.integers(0, 64, (B, L)).astype(np.int32),
+        "attention_mask": np.ones((B, L), bool),
+        "loss_mask": np.pad(np.ones((B, L - 4), np.float32), ((0, 0), (4, 0))),
+        "logprobs": rng.normal(-1, 0.1, (B, L)).astype(np.float32),
+        "rewards": rng.integers(0, 2, B).astype(np.float32),
+        "versions": np.zeros((B, L), np.int32),
+    }
+    batch["prox_logp"] = actor.compute_logp(batch)
+    actor.compute_advantages(batch)
+    stats = actor.ppo_update(batch)
+    assert np.isfinite(stats[-1]["loss"])
+    assert "moe_aux_loss" in stats[-1]
+
+
+def test_moe_generation():
+    """MoE model serves through the generation engine (prefill + decode)."""
+    from areal_tpu.gen.engine import GenEngine, GenRequest
+
+    mcfg = _moe_cfg(eos_token_id=None)
+    params = init_params(mcfg, jax.random.PRNGKey(0))
+    engine = GenEngine(mcfg, params=params, n_slots=2, max_seq_len=64,
+                       prompt_bucket=16)
+    req = GenRequest(rid="m", input_ids=[1, 2, 3], max_new_tokens=6,
+                     temperature=0.0)
+    engine.generate_blocking([req])
+    assert len(req.output_tokens) == 6

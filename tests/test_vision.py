@@ -1,0 +1,99 @@
+"""VLM groundwork tests: vision workflow plumbing, mrope position ids,
+CLEVR dataset + counting reward (VERDICT round-1 next-step #10b)."""
+
+import asyncio
+import json
+
+import numpy as np
+
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.dataset.clevr import clevr_count_reward
+from areal_tpu.utils.mrope import mrope_position_ids
+from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
+
+
+class _FakeResp:
+    def __init__(self, n_in, n_out):
+        self.input_tokens = list(range(n_in))
+        self.output_tokens = [7] * n_out
+        self.output_logprobs = [-0.5] * n_out
+        self.output_versions = [3] * n_out
+        self.input_len = n_in
+        self.output_len = n_out
+        self.stop_reason = "stop"
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.requests = []
+
+    async def agenerate(self, req):
+        self.requests.append(req)
+        return _FakeResp(len(req.input_ids), 4)
+
+
+class _FakeProcessor:
+    def __call__(self, images, text, padding=False):
+        # 2 placeholder tokens per image + 3 text tokens
+        ids = [101] * (2 * len(images)) + [5, 6, 9]
+        return {"input_ids": [ids]}
+
+
+def _reward_one(prompt, completions, prompt_ids, completion_ids, **kw):
+    return 1.0
+
+
+def test_vision_workflow_plumbs_images():
+    from areal_tpu.api.reward import prewarm_reward_pool
+
+    prewarm_reward_pool()
+    wf = VisionRLVRWorkflow(
+        reward_fn=_reward_one,
+        gconfig=GenerationHyperparameters(n_samples=2, max_new_tokens=4),
+        processor=_FakeProcessor(),
+    )
+    engine = _FakeEngine()
+    img = np.zeros((4, 4, 3), np.uint8)
+    data = {"images": [img], "messages": "count the objects", "answer": "0"}
+    batch = asyncio.run(wf.arun_episode(engine, data))
+    assert batch["input_ids"].shape[0] == 2  # n_samples rows
+    assert all(r.image_data is not None for r in engine.requests)
+    assert batch["rewards"].tolist() == [1.0, 1.0]
+    # prompt tokens masked, completion unmasked
+    assert batch["loss_mask"][0][:5].sum() == 0
+
+
+def test_mrope_position_ids():
+    IMG = 151655
+    # text text [2x2 image = 4 tokens] text
+    ids = [1, 2] + [IMG] * 4 + [3]
+    pos = mrope_position_ids(ids, IMG, [(1, 2, 2)])
+    # text advances all channels together
+    np.testing.assert_array_equal(pos[:, 0], [0, 0, 0])
+    np.testing.assert_array_equal(pos[:, 1], [1, 1, 1])
+    # image grid coords offset from pos=2: t=2; h in {2,3}; w in {2,3}
+    np.testing.assert_array_equal(pos[0, 2:6], [2, 2, 2, 2])
+    np.testing.assert_array_equal(pos[1, 2:6], [2, 2, 3, 3])
+    np.testing.assert_array_equal(pos[2, 2:6], [2, 3, 2, 3])
+    # text resumes after max extent (2 + 2 = 4)
+    np.testing.assert_array_equal(pos[:, 6], [4, 4, 4])
+
+
+def test_clevr_dataset_and_reward(tmp_path):
+    rows = [
+        {"image": "img0.png", "messages": "how many cubes?", "answer": 3},
+        {"images": ["a.png", "b.png"], "messages": "count", "answer": 7,
+         "query_id": "q7"},
+    ]
+    mf = tmp_path / "train.jsonl"
+    mf.write_text("\n".join(json.dumps(r) for r in rows))
+    ds = get_custom_dataset(path=str(tmp_path), type="clevr", split="train")
+    assert len(ds) == 2
+    assert ds[0]["answer"] == "3"
+    assert ds[0]["images"][0].endswith("img0.png")
+    assert ds[1]["query_id"] == "q7"
+
+    assert clevr_count_reward("", "the answer is 3", [], [], answer="3") == 1.0
+    assert clevr_count_reward("", "the answer is 4", [], [], answer="3") == 0.0
+    assert clevr_count_reward("", "i see 3 things maybe", [], [], answer="3") == 0.0
