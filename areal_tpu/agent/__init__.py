@@ -1,0 +1,11 @@
+from areal_tpu.agent.api import Agent, AgentWorkflow, make_agent, register_agent
+from areal_tpu.agent.math_agent import MathMultiTurnAgent, MathSingleStepAgent
+
+__all__ = [
+    "Agent",
+    "AgentWorkflow",
+    "make_agent",
+    "register_agent",
+    "MathMultiTurnAgent",
+    "MathSingleStepAgent",
+]

@@ -1,0 +1,68 @@
+"""Agent abstraction + workflow adapter.
+
+Capability counterpart of the reference's agent layer
+(realhf/api/core/agent_api.py:15 `Agent.collect_trajectory` + registry;
+driven by RolloutWorker, realhf/system/rollout_worker.py:204).  TPU-first
+difference: instead of a dedicated worker process wired through ZMQ queues,
+`AgentWorkflow` adapts any (agent, environment) pair to the asyncio
+RolloutWorkflow surface, so agentic episodes run on the same
+WorkflowExecutor/staleness machinery as plain RLVR rollouts.
+"""
+
+import abc
+from typing import Any, Callable, Dict, List, Optional
+
+from areal_tpu.api.env import Environment
+from areal_tpu.api.workflow import RolloutWorkflow
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+
+class Agent(abc.ABC):
+    """Collects one episode's trajectories against an environment."""
+
+    @abc.abstractmethod
+    async def collect_trajectory(
+        self,
+        engine,
+        env: Optional[Environment],
+        data: Dict[str, Any],
+    ) -> List[Dict[str, Any]]:
+        """Returns a list of trajectory dicts (input_ids/logprobs/loss_mask/
+        versions arrays + scalar rewards), one per sample."""
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_agent(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def make_agent(name: str, **kwargs) -> Agent:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown agent {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+class AgentWorkflow(RolloutWorkflow):
+    """(agent, env factory) -> RolloutWorkflow: each episode opens a fresh
+    environment, lets the agent collect trajectories, and emits the padded
+    batch the executor expects."""
+
+    def __init__(self, agent: Agent, env_factory: Optional[Callable] = None):
+        self.agent = agent
+        self.env_factory = env_factory
+
+    async def arun_episode(self, engine, data: Dict[str, Any]):
+        if self.env_factory is not None:
+            async with self.env_factory() as env:
+                trajs = await self.agent.collect_trajectory(engine, env, data)
+        else:
+            trajs = await self.agent.collect_trajectory(engine, None, data)
+        if not trajs:
+            return None  # rejected episode (executor drops it)
+        return pad_sequences_to_tensors(trajs)

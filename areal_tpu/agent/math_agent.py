@@ -1,0 +1,145 @@
+"""Math agents: single-step and retry-until-correct multi-turn.
+
+Capability counterpart of the reference's math agents
+(realhf/impl/agent/math_single_step_agent.py:23,
+math_multi_turn_agent.py): generate answers for a math prompt, verify via
+the environment's `verify_answer` tool, and (multi-turn) retry with
+feedback, discounting earlier turns — the agent-layer expression of the
+multi-turn workflow (workflow/multi_turn.py shares the convention).
+"""
+
+import asyncio
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.agent.api import Agent, register_agent
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+
+FEEDBACK = (
+    "\nYour answer is either wrong or not parsable. "
+    "Please try to answer it again."
+)
+
+
+def _prompt_ids(tokenizer, data: Dict[str, Any]) -> List[int]:
+    if "input_ids" in data:
+        return list(data["input_ids"])
+    if "messages" in data:
+        return tokenizer.apply_chat_template(
+            data["messages"], add_generation_prompt=True, tokenize=True
+        )
+    return tokenizer.encode(data["prompt"])
+
+
+@register_agent("math-single-step")
+class MathSingleStepAgent(Agent):
+    """n_samples independent answers per prompt, each verified once."""
+
+    def __init__(self, gconfig: GenerationHyperparameters, tokenizer=None):
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+
+    async def _one(self, engine, env, input_ids):
+        resp = await engine.agenerate(
+            ModelRequest(
+                rid=str(uuid.uuid4()),
+                input_ids=list(input_ids),
+                gconfig=self.gconfig.new(n_samples=1),
+                tokenizer=self.tokenizer,
+            )
+        )
+        completion = (
+            self.tokenizer.decode(resp.output_tokens) if self.tokenizer else ""
+        )
+        _, reward, _ = await env.aexecute_tool(
+            "verify_answer", {"completion": completion}
+        )
+        n_in, n_out = resp.input_len, resp.output_len
+        return dict(
+            input_ids=np.array(resp.input_tokens + resp.output_tokens, np.int32),
+            logprobs=np.array([0.0] * n_in + resp.output_logprobs, np.float32),
+            loss_mask=np.array([0] * n_in + [1] * n_out, np.int32),
+            versions=np.array([-1] * n_in + resp.output_versions, np.int32),
+            rewards=np.float32(reward),
+        )
+
+    async def collect_trajectory(self, engine, env, data):
+        input_ids = _prompt_ids(self.tokenizer, data)
+        return list(
+            await asyncio.gather(
+                *[
+                    self._one(engine, env, input_ids)
+                    for _ in range(self.gconfig.n_samples)
+                ]
+            )
+        )
+
+
+@register_agent("math-multi-turn")
+class MathMultiTurnAgent(Agent):
+    """Retry with feedback until the env accepts or turns run out; the
+    final reward is discounted by the number of retries."""
+
+    def __init__(
+        self,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        max_turns: int = 3,
+        turn_discount: float = 0.9,
+        feedback_text: str = FEEDBACK,
+    ):
+        self.gconfig = gconfig.new(n_samples=1)
+        self.tokenizer = tokenizer
+        self.max_turns = max_turns
+        self.turn_discount = turn_discount
+        self.feedback_text = feedback_text
+
+    async def collect_trajectory(self, engine, env, data):
+        seq = _prompt_ids(self.tokenizer, data)
+        logprobs = [0.0] * len(seq)
+        loss_mask = [0] * len(seq)
+        versions = [-1] * len(seq)
+        reward, discount = 0.0, 1.0
+        for turn in range(self.max_turns):
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=str(uuid.uuid4()),
+                    input_ids=seq,
+                    gconfig=self.gconfig,
+                    tokenizer=self.tokenizer,
+                )
+            )
+            seq = seq + resp.output_tokens
+            logprobs += resp.output_logprobs
+            loss_mask += [1] * resp.output_len
+            versions += resp.output_versions
+            completion = (
+                self.tokenizer.decode(resp.output_tokens)
+                if self.tokenizer
+                else ""
+            )
+            _, reward, done = await env.aexecute_tool(
+                "verify_answer", {"completion": completion}
+            )
+            if done or turn == self.max_turns - 1:
+                break
+            fb = self.tokenizer.encode(
+                self.feedback_text, add_special_tokens=False
+            )
+            seq += fb
+            logprobs += [0.0] * len(fb)
+            loss_mask += [0] * len(fb)
+            versions += [-1] * len(fb)
+            discount *= self.turn_discount
+        return [
+            dict(
+                input_ids=np.array(seq, np.int32),
+                logprobs=np.array(logprobs, np.float32),
+                loss_mask=np.array(loss_mask, np.int32),
+                versions=np.array(versions, np.int32),
+                rewards=np.float32(reward * discount),
+            )
+        ]

@@ -1,0 +1,45 @@
+"""Math verification environment.
+
+Capability counterpart of the reference's single-step math env
+(realhf/impl/agent/math_code_single_step_env.py): the one tool,
+`verify_answer`, checks a candidate solution against the episode's ground
+truth with the in-repo math verifier (reward/math_parser.py) and ends the
+episode.  Verification runs in the shared reward process pool so sympy
+hangs cannot block the rollout event loop.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+from areal_tpu.api.env import Environment
+from areal_tpu.api.reward import AsyncRewardWrapper
+from areal_tpu.reward.math_parser import math_verify_reward
+
+
+class MathVerifyEnv(Environment):
+    def __init__(self, answer: str):
+        self.answer = str(answer)
+        self._verify = AsyncRewardWrapper(math_verify_reward)
+
+    def list_tools(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "name": "verify_answer",
+                "description": "Check a final answer against the ground truth.",
+                "parameters": {
+                    "type": "object",
+                    "properties": {"completion": {"type": "string"}},
+                    "required": ["completion"],
+                },
+            }
+        ]
+
+    async def aexecute_tool(
+        self, tool_name: str, arguments: Dict[str, Any]
+    ) -> Tuple[Any, float, bool]:
+        if tool_name != "verify_answer":
+            raise ValueError(f"unknown tool {tool_name!r}")
+        reward = await self._verify(
+            "", arguments["completion"], [], [], answer=self.answer
+        )
+        feedback = "correct" if reward > 0 else "incorrect"
+        return feedback, float(reward), reward > 0

@@ -418,6 +418,25 @@ class JaxTrainEngine(TrainEngine):
         self.step_count += 1
         stats["total_loss_weight"] = total_weight
         stats["step_time"] = time.perf_counter() - t0
+        # per-chip MFU from the analytic flops model (the role of the
+        # reference's flops_counter + kineto categorisation, monitor.py:404)
+        from areal_tpu.utils.profiling import mfu, train_flops_per_token
+
+        seg = data["segment_ids"]
+        tokens = int((seg >= 0).sum())
+        # attention flops scale with SEGMENT length, not packed row length —
+        # rows packed with several short sequences attend within segments
+        n_segs = int(np.sum(np.where(seg.max(axis=-1) >= 0, seg.max(axis=-1) + 1, 0)))
+        mean_seg = max(1, tokens // max(1, n_segs))
+        n_chips = self.mesh.devices.size
+        tps = tokens / max(stats["step_time"], 1e-9)
+        stats["tflops_per_chip"] = (
+            tps * train_flops_per_token(self.model_config, mean_seg)
+            / 1e12 / n_chips
+        )
+        m = mfu(tps, self.model_config, mean_seg, n_chips=n_chips)
+        if m is not None:
+            stats["mfu"] = m
         return stats
 
     def eval_batch(

@@ -1,0 +1,273 @@
+"""OpenAI-compatible chat client over the inference engine.
+
+Capability counterpart of the reference's `ArealOpenAI`
+(areal/experimental/openai/client.py:216): agentic code written against the
+OpenAI chat.completions surface runs unchanged on the in-repo inference
+engine, while every completion's tokens/logprobs/versions are cached so the
+conversation can be exported as RL training data — per-completion rewards,
+backward discounted credit assignment across turns
+(`apply_reward_discount`, reference :262), and prefix-tree leaf export
+(`export_completions(style="concat")`, reference :311).
+
+The `openai` SDK is not available in this environment, so the facade is
+self-contained: `client.chat.completions.create(...)` returns a response
+object with the fields agent code actually reads (.id, .choices[0].message
+.content, .usage).
+"""
+
+import asyncio
+import itertools
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+
+
+@dataclass
+class Choice:
+    index: int
+    message: ChatMessage
+    finish_reason: str
+
+
+@dataclass
+class Usage:
+    prompt_tokens: int
+    completion_tokens: int
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_tokens + self.completion_tokens
+
+
+@dataclass
+class ChatCompletion:
+    id: str
+    choices: List[Choice]
+    usage: Usage
+    model: str = "areal-tpu"
+    object: str = "chat.completion"
+
+
+@dataclass
+class CompletionWithTokenLogpReward:
+    """Cached training-side record of one chat completion
+    (reference: experimental/openai/types.py)."""
+
+    id: str
+    messages: List[Dict[str, str]]  # the INPUT conversation
+    input_tokens: List[int]
+    output_tokens: List[int]
+    output_logprobs: List[float]
+    output_versions: List[int]
+    text: str
+    created: int
+    reward: Optional[float] = None
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    def to_trajectory(self) -> Dict[str, np.ndarray]:
+        n_in, n_out = len(self.input_tokens), len(self.output_tokens)
+        return dict(
+            input_ids=np.array(self.input_tokens + self.output_tokens, np.int32),
+            logprobs=np.array([0.0] * n_in + self.output_logprobs, np.float32),
+            loss_mask=np.array([0] * n_in + [1] * n_out, np.int32),
+            versions=np.array([-1] * n_in + self.output_versions, np.int32),
+            rewards=np.float32(self.reward if self.reward is not None else 0.0),
+        )
+
+
+class _AsyncChatCompletions:
+    def __init__(self, client: "ArealOpenAI"):
+        self._client = client
+
+    async def create(
+        self,
+        messages: List[Dict[str, str]],
+        max_completion_tokens: int = 512,
+        max_tokens: Optional[int] = None,
+        temperature: float = 1.0,
+        top_p: float = 1.0,
+        stop: Optional[List[str]] = None,
+        **_: Any,
+    ) -> ChatCompletion:
+        c = self._client
+        input_ids = c._render(messages)
+        gconfig = GenerationHyperparameters(
+            n_samples=1,
+            max_new_tokens=max_tokens or max_completion_tokens,
+            temperature=temperature,
+            top_p=top_p,
+            stop=list(stop or []),
+        )
+        resp = await c.engine.agenerate(
+            ModelRequest(
+                rid=str(uuid.uuid4()),
+                input_ids=input_ids,
+                gconfig=gconfig,
+                tokenizer=c.tokenizer,
+            )
+        )
+        text = (
+            c.tokenizer.decode(resp.output_tokens)
+            if c.tokenizer is not None
+            else ""
+        )
+        cid = f"chatcmpl-{uuid.uuid4().hex}"
+        c._cache[cid] = CompletionWithTokenLogpReward(
+            id=cid,
+            messages=[dict(m) for m in messages],
+            input_tokens=list(resp.input_tokens),
+            output_tokens=list(resp.output_tokens),
+            output_logprobs=list(resp.output_logprobs),
+            output_versions=list(resp.output_versions),
+            text=text,
+            created=next(c._counter),
+        )
+        finish = "stop" if resp.stop_reason == "stop" else "length"
+        return ChatCompletion(
+            id=cid,
+            choices=[
+                Choice(0, ChatMessage(role="assistant", content=text), finish)
+            ],
+            usage=Usage(len(resp.input_tokens), len(resp.output_tokens)),
+        )
+
+
+class _Chat:
+    def __init__(self, client: "ArealOpenAI"):
+        self.completions = _AsyncChatCompletions(client)
+
+
+class ArealOpenAI:
+    """client.chat.completions.create over an InferenceEngine, with reward
+    bookkeeping for RL export."""
+
+    def __init__(self, engine, tokenizer=None, enable_thinking: bool = False):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.enable_thinking = enable_thinking
+        self._cache: Dict[str, CompletionWithTokenLogpReward] = {}
+        self._counter = itertools.count()
+        self.chat = _Chat(self)
+
+    # -- rendering -----------------------------------------------------
+    def _render(self, messages: List[Dict[str, str]]) -> List[int]:
+        if self.tokenizer is None:
+            raise ValueError("ArealOpenAI needs a tokenizer")
+        try:
+            return self.tokenizer.apply_chat_template(
+                messages,
+                add_generation_prompt=True,
+                tokenize=True,
+                enable_thinking=self.enable_thinking,
+            )
+        except TypeError:  # tokenizers without the enable_thinking kwarg
+            return self.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True, tokenize=True
+            )
+
+    # -- reward bookkeeping (reference :250-310) -----------------------
+    def get_completions(self, cid: str) -> Optional[CompletionWithTokenLogpReward]:
+        return self._cache.get(cid)
+
+    def set_reward(self, cid: str, reward: float) -> None:
+        if cid not in self._cache:
+            raise KeyError(f"completion {cid} not in cache")
+        self._cache[cid].reward = reward
+
+    def apply_reward_discount(
+        self, turn_discount: float = 1.0
+    ) -> Dict[str, CompletionWithTokenLogpReward]:
+        """Backward geometric credit assignment across turns: in reverse
+        creation order, reward[i] += reward[i+1] * turn_discount."""
+        ordered = sorted(self._cache.values(), key=lambda c: c.created)
+        carry = None
+        for comp in reversed(ordered):
+            if comp.reward is None:
+                comp.reward = 0.0
+            if carry is not None:
+                comp.reward += carry * turn_discount
+            carry = comp.reward
+        return dict(self._cache)
+
+    # -- export (reference :311-420) -----------------------------------
+    def export_completions(
+        self, style: str = "concat"
+    ) -> Dict[str, CompletionWithTokenLogpReward]:
+        """'individual': every cached completion.  'concat': build the
+        conversation prefix tree (completion A is B's ancestor iff A's
+        input messages + A's reply form a prefix of B's input) and return
+        only leaves — one trajectory per conversation branch."""
+        if style == "individual":
+            return dict(self._cache)
+        if style != "concat":
+            raise ValueError(f"unknown export style {style!r}")
+        comps = list(self._cache.values())
+        has_child = set()
+        for a in comps:
+            a_full = a.messages + [{"role": "assistant", "content": a.text}]
+            for b in comps:
+                if a is b:
+                    continue
+                if len(a_full) <= len(b.messages) and all(
+                    a_full[i] == b.messages[i] for i in range(len(a_full))
+                ):
+                    has_child.add(a.id)
+                    break
+        return {c.id: c for c in comps if c.id not in has_child}
+
+    def _ancestors(self, leaf: CompletionWithTokenLogpReward):
+        """Chain of cached completions whose (input + reply) token stream is
+        a strict prefix of `leaf`'s input tokens, shortest first."""
+        chain = []
+        for c in self._cache.values():
+            if c is leaf:
+                continue
+            full = c.input_tokens + c.output_tokens
+            if len(full) <= len(leaf.input_tokens) and leaf.input_tokens[
+                : len(full)
+            ] == full:
+                chain.append(c)
+        return sorted(chain, key=lambda c: len(c.input_tokens))
+
+    def _chain_trajectory(self, leaf: CompletionWithTokenLogpReward):
+        """Leaf trajectory with every ancestor turn's reply span trained
+        (stored logprobs/versions restored at its token positions) — valid
+        whenever turns extend the conversation by exact token concatenation;
+        otherwise only the leaf's reply is trainable (re-tokenised chat
+        templates break position tracking, the same restriction the
+        reference's 'concat' export enforces, client.py:311)."""
+        traj = leaf.to_trajectory()
+        for anc in self._ancestors(leaf):
+            start = len(anc.input_tokens)
+            end = start + len(anc.output_tokens)
+            traj["loss_mask"][start:end] = 1
+            traj["logprobs"][start:end] = anc.output_logprobs
+            traj["versions"][start:end] = anc.output_versions
+        return traj
+
+    def export_batch(self, style: str = "concat") -> Dict[str, np.ndarray]:
+        """Padded trajectory batch for the train engines.  'concat' rows
+        train on every turn of each conversation branch (ancestor replies
+        included); 'individual' emits one row per completion."""
+        comps = sorted(
+            self.export_completions(style).values(), key=lambda c: c.created
+        )
+        if not comps:
+            raise ValueError("no completions cached")
+        if style == "concat":
+            return pad_sequences_to_tensors(
+                [self._chain_trajectory(c) for c in comps]
+            )
+        return pad_sequences_to_tensors([c.to_trajectory() for c in comps])

@@ -1,0 +1,92 @@
+"""Device profiling + FLOPs/MFU accounting.
+
+Capability counterpart of the reference's monitoring stack
+(realhf/base/monitor.py:404-678 kineto CUDA kernel-time categorisation,
+realhf/base/flops_counter.py): on TPU the device timeline comes from
+`jax.profiler` (xplane traces viewable in TensorBoard/Perfetto) and FLOPs
+from the analytic transformer model below, folded into per-step MFU that
+the train engine reports with every batch.
+"""
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from areal_tpu.models.model_config import TransformerConfig
+
+# peak bf16 TFLOP/s by device kind (known TPU generations)
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+}
+
+
+def device_peak_tflops(device=None) -> Optional[float]:
+    kind = (device or jax.devices()[0]).device_kind
+    for k in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if kind.startswith(k):
+            return PEAK_TFLOPS[k]
+    return None
+
+
+def param_count(cfg: TransformerConfig) -> int:
+    """Analytic parameter count of the dense/MoE transformer."""
+    D, F, V, L = (
+        cfg.hidden_size,
+        cfg.intermediate_size,
+        cfg.vocab_size,
+        cfg.num_layers,
+    )
+    attn = D * (cfg.q_size + 2 * cfg.kv_size) + cfg.q_size * D
+    if cfg.num_experts > 0:
+        Fm = cfg.moe_intermediate_size or F
+        ffn = cfg.num_experts * 3 * D * Fm + D * cfg.num_experts
+    else:
+        ffn = 3 * D * F
+    embed = V * D * (1 if cfg.tie_word_embeddings else 2)
+    return L * (attn + ffn + 2 * D) + embed + D
+
+
+def train_flops_per_token(cfg: TransformerConfig, ctx_len: int) -> float:
+    """fwd+bwd FLOPs per trained token: the standard 6P matmul estimate
+    (active params only for MoE) plus causal attention's 6*L*D_attn*ctx
+    term, which dominates at long context."""
+    P = param_count(cfg)
+    if cfg.num_experts > 0:
+        Fm = cfg.moe_intermediate_size or cfg.intermediate_size
+        dense_share = cfg.num_experts_per_tok * 3 * cfg.hidden_size * Fm
+        all_experts = cfg.num_experts * 3 * cfg.hidden_size * Fm
+        P = P - cfg.num_layers * (all_experts - dense_share)
+    attn = 6 * cfg.num_layers * cfg.q_size * ctx_len / 2  # causal half
+    return 6.0 * P + 2.0 * attn  # qk^T and pv matmuls, fwd+bwd
+
+
+def mfu(
+    tokens_per_sec: float,
+    cfg: TransformerConfig,
+    ctx_len: int,
+    n_chips: int = 1,
+    peak_tflops: Optional[float] = None,
+) -> Optional[float]:
+    peak = peak_tflops or device_peak_tflops()
+    if not peak:
+        return None
+    achieved = tokens_per_sec * train_flops_per_token(cfg, ctx_len) / 1e12
+    return achieved / (peak * n_chips)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]):
+    """jax.profiler device trace scope; no-op when log_dir is falsy.  View
+    with TensorBoard's profile plugin or Perfetto."""
+    if not log_dir:
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield

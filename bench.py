@@ -36,30 +36,15 @@ import numpy as np
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 9800.0
 
 MODEL = "qwen25_1p5b"
-N_PARAMS = 1.54e9
 WARMUP_STEPS = 4
 MEASURE_STEPS = 5
-
-# peak bf16 TFLOP/s by device kind (for the MFU line only)
-PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5p": 459.0,
-    "TPU v5": 459.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-}
-
 
 def _peak_tflops():
     import jax
 
-    kind = jax.devices()[0].device_kind
-    for k in sorted(PEAK_TFLOPS, key=len, reverse=True):
-        if kind.startswith(k):
-            return PEAK_TFLOPS[k], kind
-    return None, kind
+    from areal_tpu.utils.profiling import device_peak_tflops
+
+    return device_peak_tflops(), jax.devices()[0].device_kind
 
 
 def _make_batch(rng, n_rows, row_len, vocab, seqs_per_row=2):
@@ -132,7 +117,9 @@ def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2, group_
 
     profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
-        with jax.profiler.trace(profile_dir):
+        from areal_tpu.utils.profiling import profile_trace
+
+        with profile_trace(profile_dir):
             actor.ppo_update(batch)
             actor.ppo_update(batch)
             jax.block_until_ready(actor.params)
@@ -153,7 +140,9 @@ def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2, group_
         "tokens_per_step": tokens_per_step,
     }
     peak, kind = _peak_tflops()
-    model_tflops = tokens_per_step * 6 * N_PARAMS / dt / 1e12
+    from areal_tpu.utils.profiling import param_count
+
+    model_tflops = tokens_per_step * 6 * param_count(model_cfg) / dt / 1e12
     result["model_tflops_per_sec"] = round(model_tflops, 1)
     result["device_kind"] = kind
     if peak:

@@ -1,0 +1,99 @@
+"""Agent layer tests: math env, single-step and multi-turn agents, the
+AgentWorkflow adapter (reference analog: realhf/impl/agent math agents +
+rollout-worker driving; here the asyncio workflow surface drives them)."""
+
+import asyncio
+
+import numpy as np
+
+from areal_tpu.agent import AgentWorkflow, MathMultiTurnAgent, MathSingleStepAgent, make_agent
+from areal_tpu.agent.math_env import MathVerifyEnv
+from areal_tpu.api.config import GenerationHyperparameters
+
+
+class _Tok:
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) % 256 for c in text]
+
+    def decode(self, tokens):
+        return "".join(chr(t) for t in tokens)
+
+    def apply_chat_template(self, messages, **kw):
+        return self.encode("".join(m["content"] for m in messages))
+
+
+class _ScriptedEngine:
+    """Replies from a script, one entry per agenerate call."""
+
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.calls = 0
+
+    async def agenerate(self, req):
+        text = self.replies[min(self.calls, len(self.replies) - 1)]
+        self.calls += 1
+        out = [ord(c) % 256 for c in text]
+
+        class R:
+            input_tokens = list(req.input_ids)
+            output_tokens = out
+            output_logprobs = [-0.2] * len(out)
+            output_versions = [1] * len(out)
+            input_len = len(req.input_ids)
+            output_len = len(out)
+            stop_reason = "stop"
+
+        return R()
+
+
+def test_math_env_verifies():
+    async def run():
+        async with MathVerifyEnv("42") as env:
+            assert env.list_tools()[0]["name"] == "verify_answer"
+            _, r_good, done = await env.aexecute_tool(
+                "verify_answer", {"completion": "the answer is 42"}
+            )
+            _, r_bad, _ = await env.aexecute_tool(
+                "verify_answer", {"completion": "the answer is 41"}
+            )
+            return r_good, done, r_bad
+
+    r_good, done, r_bad = asyncio.run(run())
+    assert r_good == 1.0 and done
+    assert r_bad == 0.0
+
+
+def test_single_step_agent_workflow():
+    agent = MathSingleStepAgent(
+        GenerationHyperparameters(n_samples=2, max_new_tokens=8), tokenizer=_Tok()
+    )
+    wf = AgentWorkflow(agent, env_factory=lambda: MathVerifyEnv("7"))
+    engine = _ScriptedEngine(["the answer is 7"])
+    batch = asyncio.run(wf.arun_episode(engine, {"prompt": "what is 3+4?"}))
+    assert batch["input_ids"].shape[0] == 2
+    np.testing.assert_array_equal(batch["rewards"], [1.0, 1.0])
+
+
+def test_multi_turn_agent_retries_with_discount():
+    agent = MathMultiTurnAgent(
+        GenerationHyperparameters(max_new_tokens=8),
+        tokenizer=_Tok(),
+        max_turns=3,
+        turn_discount=0.5,
+    )
+    wf = AgentWorkflow(agent, env_factory=lambda: MathVerifyEnv("9"))
+    engine = _ScriptedEngine(["the answer is 3", "the answer is 9"])
+    batch = asyncio.run(wf.arun_episode(engine, {"prompt": "what is 4+5?"}))
+    assert engine.calls == 2  # wrong once, then correct
+    np.testing.assert_allclose(batch["rewards"], [0.5])  # one retry discount
+    # feedback tokens are present but not trained on
+    assert batch["loss_mask"].sum() < (batch["input_ids"] != 0).sum()
+
+
+def test_agent_registry():
+    agent = make_agent(
+        "math-multi-turn",
+        gconfig=GenerationHyperparameters(),
+        tokenizer=_Tok(),
+    )
+    assert isinstance(agent, MathMultiTurnAgent)

@@ -176,3 +176,63 @@ def test_extract_answer():
     assert extract_answer("first 3 then 9 finally") is None
     assert extract_answer("first 3 then 9 finally", strict=False) == "9"
     assert extract_answer("no numbers here") is None
+
+
+def test_profiling_flops_and_mfu():
+    from areal_tpu.models.model_config import qwen25_1p5b, tiny_config
+    from areal_tpu.utils import profiling
+
+    cfg = qwen25_1p5b()
+    P = profiling.param_count(cfg)
+    assert 1.4e9 < P < 1.7e9  # qwen2.5-1.5b is ~1.54B params
+    f = profiling.train_flops_per_token(cfg, ctx_len=2048)
+    assert f > 6 * P  # attention adds on top of the 6P matmul estimate
+    # MoE counts active experts only
+    moe = tiny_config(num_experts=8, num_experts_per_tok=2)
+    dense_like = tiny_config()
+    assert profiling.train_flops_per_token(
+        moe, 128
+    ) < 8 / 2 * profiling.train_flops_per_token(dense_like, 128)
+    # mfu is None on unknown devices instead of lying (CPU here)
+    assert profiling.mfu(1e4, cfg, 2048) is None
+    assert profiling.mfu(1e4, cfg, 2048, peak_tflops=197.0) > 0
+
+
+def test_train_stats_report_mfu():
+    import numpy as np
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.jax_train import JaxTrainEngine
+    from areal_tpu.models.model_config import tiny_config
+    from areal_tpu.ops import sft_loss_fn
+
+    eng = JaxTrainEngine(
+        TrainEngineConfig(
+            experiment_name="prof", trial_name="t", init_from_scratch=True,
+            dtype="float32", param_dtype="float32",
+            gradient_checkpointing=False, mesh=MeshConfig(),
+            mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            pack_length_quantum=32, max_pack_length=64,
+        ),
+        model_config=tiny_config(),
+    )
+    eng.initialize(ft_spec=FinetuneSpec(1, 16, 4))
+    rng = np.random.default_rng(0)
+    B, L = 2, 24
+    stats = eng.train_batch(
+        {
+            "input_ids": rng.integers(0, 512, (B, L)).astype(np.int32),
+            "attention_mask": np.ones((B, L), bool),
+            "loss_mask": np.ones((B, L), np.float32),
+        },
+        sft_loss_fn,
+        lambda b: float(np.sum(b["loss_mask"])),
+    )
+    assert stats["tflops_per_chip"] > 0
