@@ -1,0 +1,4 @@
+from areal_tpu.controller.batch import DistributedBatch
+from areal_tpu.controller.train_controller import TrainController
+
+__all__ = ["DistributedBatch", "TrainController"]

@@ -1,0 +1,121 @@
+"""Batch container for the single-controller RPC layer.
+
+Behavioral counterpart of the reference's `DistributedBatchMemory`
+(areal/controller/batch.py:16): a wire-serializable wrapper over a padded
+tensor dict that a controller can split across data-parallel engine workers
+(`chunk`), merge back (`concat`), and join column-wise (`union`).  Arrays are
+numpy host-side; serialization is a single npz blob plus a JSON side-channel
+for non-array metadata, so an RPC payload is one POST body with no pickle.
+"""
+
+import io
+import json
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from areal_tpu.utils.data import batch_size, concat_padded_tensors, select_rows
+
+
+class DistributedBatch:
+    def __init__(self, data: Dict[str, Any]):
+        self.arrays: Dict[str, np.ndarray] = {}
+        self.meta: Dict[str, Any] = {}
+        for k, v in data.items():
+            if isinstance(v, np.ndarray):
+                self.arrays[k] = v
+            elif isinstance(v, (list, tuple)) and v and isinstance(v[0], (int, float)):
+                self.arrays[k] = np.asarray(v)
+            else:
+                self.meta[k] = v
+
+    # ------------------------------ dict-like ---------------------------
+
+    def __getitem__(self, key: str):
+        if key in self.arrays:
+            return self.arrays[key]
+        return self.meta[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.arrays or key in self.meta
+
+    def keys(self):
+        yield from self.arrays
+        yield from self.meta
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {**self.arrays, **self.meta}
+
+    def __len__(self) -> int:
+        if not self.arrays:
+            return 0
+        try:
+            return batch_size(self.arrays)
+        except ValueError:
+            # no canonical keys (e.g. a bare result column): rows = dim 0
+            return len(next(iter(self.arrays.values())))
+
+    # ------------------------------ split/merge -------------------------
+
+    def chunk(self, n: int, quantum: int = 1) -> List["DistributedBatch"]:
+        """Split rows into n near-equal contiguous shards (dp fan-out).
+
+        `quantum` keeps shard boundaries on multiples of a group size so
+        grouped ops downstream (GRPO group normalization) never see a
+        fractured group.  Rows must divide evenly into quantum blocks and
+        there must be at least one block per shard."""
+        total = len(self)
+        if quantum > 1 and total % quantum:
+            raise ValueError(f"{total} rows not divisible by quantum {quantum}")
+        blocks = total // quantum
+        if blocks < n:
+            raise ValueError(
+                f"cannot chunk {total} rows ({blocks} blocks of {quantum}) "
+                f"into {n} shards"
+            )
+        bounds = (np.linspace(0, blocks, n + 1).astype(int)) * quantum
+        out = []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            shard = select_rows(self.arrays, list(range(lo, hi)))
+            b = DistributedBatch(shard)
+            b.meta = dict(self.meta)
+            out.append(b)
+        return out
+
+    @staticmethod
+    def concat(batches: Sequence["DistributedBatch"]) -> "DistributedBatch":
+        merged = concat_padded_tensors([b.arrays for b in batches])
+        out = DistributedBatch(merged)
+        for b in batches:
+            out.meta.update(b.meta)
+        return out
+
+    def union(self, other: "DistributedBatch") -> "DistributedBatch":
+        """Column-wise join: add the other batch's keys (same rows)."""
+        if len(other) not in (0, len(self)):
+            raise ValueError(f"union row mismatch: {len(self)} vs {len(other)}")
+        data = {**self.arrays, **other.arrays}
+        out = DistributedBatch(data)
+        out.meta = {**self.meta, **other.meta}
+        return out
+
+    # ------------------------------ wire format -------------------------
+
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        arrays = dict(self.arrays)
+        arrays["__meta_json__"] = np.frombuffer(
+            json.dumps(self.meta).encode(), dtype=np.uint8
+        )
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "DistributedBatch":
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta_raw = arrays.pop("__meta_json__", None)
+        out = cls(arrays)
+        if meta_raw is not None:
+            out.meta = json.loads(bytes(meta_raw.tobytes()).decode())
+        return out

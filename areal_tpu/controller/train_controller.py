@@ -1,0 +1,119 @@
+"""Single-controller training driver over RPC engine workers.
+
+Behavioral counterpart of the reference's `TrainController`
+(areal/api/controller_api.py:207) with `DistributedBatchMemory` fan-out
+(areal/controller/batch.py): algorithm code runs here, in one process; each
+batch-consuming call is chunked row-wise across the worker fleet, issued
+concurrently, and the results are merged — stats averaged weighted by shard rows, arrays
+concatenated in row order.
+"""
+
+import concurrent.futures
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from areal_tpu.controller.batch import DistributedBatch
+from areal_tpu.scheduler.rpc_client import RPCEngineClient
+
+
+def _merge_stats(
+    per_worker: Sequence[List[Dict[str, float]]],
+    weights: Sequence[float],
+) -> List[Dict[str, float]]:
+    """Average each minibatch-step's stats dict across workers, weighted by
+    each worker's shard size so uneven shards don't bias the metrics."""
+    n_steps = max(len(w) for w in per_worker)
+    out = []
+    for i in range(n_steps):
+        acc: Dict[str, List[tuple]] = {}
+        for w, wt in zip(per_worker, weights):
+            if i < len(w):
+                for k, v in w[i].items():
+                    if isinstance(v, (int, float)):
+                        acc.setdefault(k, []).append((float(v), wt))
+        out.append(
+            {
+                k: float(
+                    sum(v * wt for v, wt in vs) / max(sum(wt for _, wt in vs), 1e-8)
+                )
+                for k, vs in acc.items()
+            }
+        )
+    return out
+
+
+class TrainController:
+    def __init__(self, clients: List[RPCEngineClient], chunk_quantum: int = 1):
+        """`chunk_quantum` aligns dp shard boundaries to a group size
+        (GRPO group_size) so group-normalized ops never straddle shards."""
+        if not clients:
+            raise ValueError("need at least one engine worker")
+        self.clients = clients
+        self.chunk_quantum = chunk_quantum
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=len(clients)
+        )
+
+    @property
+    def dp_size(self) -> int:
+        return len(self.clients)
+
+    def _fan(self, fn_name: str, batch: Dict[str, Any], **kw):
+        shards = DistributedBatch(batch).chunk(
+            self.dp_size, quantum=self.chunk_quantum
+        )
+        futs = [
+            self._pool.submit(getattr(c, "call"), fn_name, s.to_dict(), **kw)
+            for c, s in zip(self.clients, shards)
+        ]
+        return [f.result() for f in futs], [len(s) for s in shards]
+
+    # ---------------------------- algorithm ops -------------------------
+
+    def compute_logp(self, batch: Dict[str, Any]) -> np.ndarray:
+        parts, _ = self._fan("compute_logp", batch)
+        return np.concatenate(parts, axis=0)
+
+    def compute_advantages(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        parts, _ = self._fan("compute_advantages", batch, return_batch=True)
+        merged = DistributedBatch.concat(
+            [DistributedBatch(p) for p in parts]
+        ).to_dict()
+        batch.update(merged)
+        return batch
+
+    def ppo_update(self, batch: Dict[str, Any]) -> List[Dict[str, float]]:
+        results, sizes = self._fan("ppo_update", batch)
+        return _merge_stats(results, sizes)
+
+    # ---------------------------- control plane -------------------------
+
+    def _all(self, method: str, **kw):
+        futs = [
+            self._pool.submit(c.call, method, **kw) for c in self.clients
+        ]
+        return [f.result() for f in futs]
+
+    def set_version(self, version: int):
+        self._all("set_version", version=version)
+
+    def get_version(self) -> int:
+        return self.clients[0].get_version()
+
+    def step_lr_scheduler(self):
+        self._all("step_lr_scheduler")
+
+    def update_weights(self, meta):
+        """Weight publishing is a head-worker action (every worker holds the
+        same replicated/sharded state; one snapshot suffices)."""
+        return self.clients[0].update_weights(meta)
+
+    def save(self, meta):
+        return self.clients[0].save(meta)
+
+    def load(self, meta):
+        return self._all("load", meta=meta)
+
+    def health(self) -> List[Dict[str, Any]]:
+        return [c.health() for c in self.clients]

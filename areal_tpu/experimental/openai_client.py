@@ -189,16 +189,37 @@ class ArealOpenAI:
     def apply_reward_discount(
         self, turn_discount: float = 1.0
     ) -> Dict[str, CompletionWithTokenLogpReward]:
-        """Backward geometric credit assignment across turns: in reverse
-        creation order, reward[i] += reward[i+1] * turn_discount."""
+        """Backward geometric credit assignment along each conversation's
+        prefix chain: every completion's reward flows to its parent turn
+        scaled by turn_discount (cascading, so a leaf reaches its
+        grandparent as discount^2).  Parents are resolved with the same
+        prefix rule as export_completions, so interleaved independent
+        conversations never leak reward into each other."""
         ordered = sorted(self._cache.values(), key=lambda c: c.created)
-        carry = None
-        for comp in reversed(ordered):
+        full = {c.id: c.messages + [{"role": "assistant", "content": c.text}]
+                for c in ordered}
+        parent: Dict[str, CompletionWithTokenLogpReward] = {}
+        for b in ordered:
+            best = None
+            for a in ordered:
+                if a is b or len(full[a.id]) > len(b.messages):
+                    continue
+                if full[a.id] == b.messages[: len(full[a.id])]:
+                    # deepest ancestor wins; among equal-depth duplicates
+                    # (re-sampled identical turns) prefer the latest created
+                    if best is None or len(a.messages) >= len(best.messages):
+                        best = a
+            if best is not None:
+                parent[b.id] = best
+        for comp in ordered:
             if comp.reward is None:
                 comp.reward = 0.0
-            if carry is not None:
-                comp.reward += carry * turn_discount
-            carry = comp.reward
+        # reverse creation order: children resolve before their parents, so
+        # discounted reward cascades leaf -> ... -> root
+        for comp in reversed(ordered):
+            p = parent.get(comp.id)
+            if p is not None:
+                p.reward += comp.reward * turn_discount
         return dict(self._cache)
 
     # -- export (reference :311-420) -----------------------------------
@@ -216,13 +237,8 @@ class ArealOpenAI:
         comps = list(self._cache.values())
         has_child = set()
         for a in comps:
-            a_full = a.messages + [{"role": "assistant", "content": a.text}]
             for b in comps:
-                if a is b:
-                    continue
-                if len(a_full) <= len(b.messages) and all(
-                    a_full[i] == b.messages[i] for i in range(len(a_full))
-                ):
+                if a is not b and _is_prefix_ancestor(a, b):
                     has_child.add(a.id)
                     break
         return {c.id: c for c in comps if c.id not in has_child}
@@ -271,3 +287,14 @@ class ArealOpenAI:
                 [self._chain_trajectory(c) for c in comps]
             )
         return pad_sequences_to_tensors([c.to_trajectory() for c in comps])
+
+
+def _is_prefix_ancestor(
+    a: CompletionWithTokenLogpReward, b: CompletionWithTokenLogpReward
+) -> bool:
+    """True iff a's input messages + a's reply form a prefix of b's input —
+    i.e. b continues the conversation that produced a."""
+    a_full = a.messages + [{"role": "assistant", "content": a.text}]
+    return len(a_full) <= len(b.messages) and all(
+        a_full[i] == b.messages[i] for i in range(len(a_full))
+    )

@@ -1,0 +1,364 @@
+"""Generation-side router/controller service.
+
+Behavioral counterpart of the reference's `GserverManager`
+(realhf/system/gserver_manager.py:32): a standalone HTTP service sitting in
+front of N generation servers that
+
+- **routes** `/generate` to a backend under a configurable policy —
+  round_robin | least_requests | least_tokens — with rid->server affinity so
+  interruption re-submissions land on the server that already holds the KV
+  prefix (gserver_manager.py:175-191 routing policies; :351 routing service);
+- **gates rollout admission globally**: `/allocate_request` applies the
+  staleness capacity formula across ALL clients of the fleet, not per-client
+  like the in-process StalenessManager (is_staled, gserver_manager.py:334);
+- **watches for new checkpoints** published by the trainer in name_resolve
+  and flushes + updates every backend: pause all -> update_weights_from_disk
+  -> resume all, bumping the served version (check_new_params
+  gserver_manager.py:131, flush_requests_and_update_weights :158).
+
+Clients need no new protocol: the router speaks the same wire format as a
+generation server (areal_tpu/gen/server.py), so RemoteInfEngine can point at
+the router exactly as it would at one big server.
+"""
+
+import argparse
+import asyncio
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from areal_tpu.utils import logging, name_resolve, names, network
+
+logger = logging.getLogger("gen.router")
+
+RID_CACHE_SIZE = 8192
+
+
+@dataclass
+class RouterConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    schedule_policy: str = "least_requests"  # round_robin | least_requests | least_tokens
+    # global staleness gate (capacity formula shared with core/staleness.py)
+    train_batch_size: int = 0  # 0 => gate disabled
+    max_head_offpolicyness: int = 0
+    # checkpoint watcher
+    weights_path: str = ""  # trainer's WeightUpdateMeta.path; ckpts at v{N}/
+    poll_interval: float = 1.0
+    request_timeout: float = 3600.0
+
+
+class Router:
+    def __init__(self, config: RouterConfig, addresses: Optional[List[str]] = None):
+        self.config = config
+        self.addresses: List[str] = list(addresses or [])
+        self.version = 0
+        self._rr = 0
+        self._inflight: Dict[str, int] = {}
+        self._tokens: Dict[str, int] = {}
+        self._rid_to_addr: "OrderedDict[str, str]" = OrderedDict()
+        # global rollout accounting for the staleness gate
+        self._running = 0
+        self._accepted = 0
+        self._lock = asyncio.Lock()
+        self._flush_lock = asyncio.Lock()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._watcher: Optional[asyncio.Task] = None
+        self.n_flushes = 0
+
+    # ---------------------------- scheduling ----------------------------
+
+    def _choose(self) -> str:
+        policy = self.config.schedule_policy
+        if policy == "least_requests":
+            return min(self.addresses, key=lambda a: self._inflight.get(a, 0))
+        if policy == "least_tokens":
+            return min(self.addresses, key=lambda a: self._tokens.get(a, 0))
+        addr = self.addresses[self._rr % len(self.addresses)]
+        self._rr += 1
+        return addr
+
+    def _server_for_rid(self, rid: str) -> str:
+        if rid and rid in self._rid_to_addr:
+            self._rid_to_addr.move_to_end(rid)
+            return self._rid_to_addr[rid]
+        addr = self._choose()
+        if rid:
+            if len(self._rid_to_addr) >= RID_CACHE_SIZE:
+                self._rid_to_addr.popitem(last=False)
+            self._rid_to_addr[rid] = addr
+        return addr
+
+    # ------------------------- staleness gate ---------------------------
+
+    def _capacity(self) -> Optional[int]:
+        """Remaining global admissions, or None when the gate is disabled.
+
+        Same formula as StalenessManager.get_capacity (reference
+        staleness_manager.py:96) evaluated fleet-wide: samples admitted so
+        far may not exceed (staleness + version + 1) * train_batch_size."""
+        bs = self.config.train_batch_size
+        if bs <= 0:
+            return None
+        allowed = (self.config.max_head_offpolicyness + self.version + 1) * bs
+        return allowed - (self._running + self._accepted)
+
+    # ---------------------------- handlers ------------------------------
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        rid = body.get("rid", "")
+        async with self._lock:
+            addr = self._server_for_rid(rid)
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+            self._tokens[addr] = self._tokens.get(addr, 0) + len(
+                body.get("input_ids", ())
+            )
+        try:
+            async with self._session.post(
+                f"http://{addr}/generate", json=body
+            ) as resp:
+                payload = await resp.json()
+                status = resp.status
+        finally:
+            async with self._lock:
+                self._inflight[addr] = self._inflight.get(addr, 1) - 1
+        if status == 200:
+            async with self._lock:
+                self._tokens[addr] = self._tokens.get(addr, 0) + len(
+                    payload.get("output_tokens", ())
+                )
+        return web.json_response(payload, status=status)
+
+    async def allocate_request(self, request: web.Request) -> web.Response:
+        """Admission control for a new rollout sample.  Returns the server
+        the client should use, or 409 when the fleet is staleness-bound."""
+        body = await request.json()
+        async with self._lock:
+            cap = self._capacity()
+            if cap is not None and cap <= 0:
+                return web.json_response(
+                    {"staled": True, "version": self.version}, status=409
+                )
+            self._running += 1
+            addr = self._server_for_rid(body.get("qid", ""))
+        return web.json_response(
+            {"server": addr, "version": self.version, "staled": False}
+        )
+
+    async def finish_request(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        async with self._lock:
+            self._running = max(0, self._running - 1)
+            if body.get("accepted", True):
+                self._accepted += 1
+        return web.json_response({"ok": True})
+
+    async def update_weights(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        version = await self._flush_and_update(
+            body["path"], body.get("version")
+        )
+        return web.json_response({"ok": True, "version": version})
+
+    async def pause(self, request: web.Request) -> web.Response:
+        await self._fanout("/pause_generation", {})
+        return web.json_response({"ok": True})
+
+    async def resume(self, request: web.Request) -> web.Response:
+        await self._fanout("/continue_generation", {})
+        return web.json_response({"ok": True})
+
+    async def health(self, request: web.Request) -> web.Response:
+        states = {}
+        for a in self.addresses:
+            try:
+                async with self._session.get(
+                    f"http://{a}/health", timeout=aiohttp.ClientTimeout(total=5)
+                ) as resp:
+                    states[a] = await resp.json()
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                states[a] = {"status": "unreachable", "error": str(e)}
+        ok = all(s.get("status") in ("ok", "paused") for s in states.values())
+        return web.json_response(
+            {"status": "ok" if ok else "degraded", "version": self.version,
+             "servers": states},
+            status=200 if ok else 503,
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            cap = self._capacity()
+            return web.json_response(
+                {
+                    "version": self.version,
+                    "inflight": dict(self._inflight),
+                    "tokens_routed": dict(self._tokens),
+                    "running": self._running,
+                    "accepted": self._accepted,
+                    "capacity": cap,
+                    "n_flushes": self.n_flushes,
+                }
+            )
+
+    # ------------------------ flush + update ----------------------------
+
+    async def _one_post(self, addr: str, endpoint: str, payload: dict,
+                        timeout: float = 300.0):
+        async with self._session.post(
+            f"http://{addr}{endpoint}",
+            json=payload,
+            timeout=aiohttp.ClientTimeout(total=timeout),
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _fanout(self, endpoint: str, payload: dict, timeout: float = 300.0):
+        return await asyncio.gather(
+            *[self._one_post(a, endpoint, payload, timeout) for a in self.addresses]
+        )
+
+    async def _flush_and_update(self, path: str, version: Optional[int]) -> int:
+        """Pause every backend (in-flight requests abort and resume client-
+        side with fresh weights — interruptible generation), swap weights,
+        resume (reference flush_requests_and_update_weights,
+        gserver_manager.py:158)."""
+        async with self._flush_lock:
+            try:
+                await self._fanout("/pause_generation", {})
+                results = await self._fanout(
+                    "/update_weights_from_disk",
+                    {"path": path, "version": version},
+                )
+            finally:
+                # always resume — a failed pause/update on one backend must
+                # not leave the healthy rest of the fleet parked
+                await asyncio.gather(
+                    *[
+                        self._one_post(a, "/continue_generation", {})
+                        for a in self.addresses
+                    ],
+                    return_exceptions=True,
+                )
+            async with self._lock:
+                self.version = (
+                    version
+                    if version is not None
+                    else max(r.get("version", 0) for r in results)
+                )
+                self.n_flushes += 1
+            logger.info(f"weights updated to v{self.version} on "
+                        f"{len(self.addresses)} servers")
+            return self.version
+
+    async def _watch_checkpoints(self):
+        """Poll name_resolve for trainer-published weight versions newer than
+        what the fleet serves (reference check_new_params,
+        gserver_manager.py:131)."""
+        root = names.update_weights_from_disk(
+            self.config.experiment_name, self.config.trial_name, ""
+        ).rstrip("/")
+        while True:
+            try:
+                keys = name_resolve.find_subtree(root)
+                new = [
+                    int(v)
+                    for k in keys
+                    if (v := k.rsplit("/", 1)[-1]).isdigit()
+                    and int(v) > self.version
+                ]
+                if new:
+                    version = max(new)
+                    path = f"{self.config.weights_path}/v{version}"
+                    await self._flush_and_update(path, version)
+            except Exception:  # noqa: BLE001 — watcher must survive blips
+                logger.exception("checkpoint watcher iteration failed")
+            await asyncio.sleep(self.config.poll_interval)
+
+    # ----------------------------- wiring -------------------------------
+
+    async def on_startup(self, app):
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.config.request_timeout),
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+        if not self.addresses:
+            self.addresses = await self._discover()
+        self._inflight = {a: 0 for a in self.addresses}
+        self._tokens = {a: 0 for a in self.addresses}
+        if self.config.weights_path and self.config.experiment_name:
+            self._watcher = asyncio.create_task(self._watch_checkpoints())
+        logger.info(f"router over {len(self.addresses)} servers: {self.addresses}")
+
+    async def _discover(self, timeout: float = 300.0) -> List[str]:
+        key = names.gen_servers(
+            self.config.experiment_name, self.config.trial_name
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            found = name_resolve.get_subtree(key)
+            if found:
+                return sorted(found)
+            await asyncio.sleep(0.5)
+        raise TimeoutError(f"no generation servers under {key}")
+
+    async def on_cleanup(self, app):
+        if self._watcher is not None:
+            self._watcher.cancel()
+        if self._session is not None:
+            await self._session.close()
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_post("/generate", self.generate)
+        app.router.add_post("/allocate_request", self.allocate_request)
+        app.router.add_post("/finish_request", self.finish_request)
+        app.router.add_post("/update_weights", self.update_weights)
+        app.router.add_post("/pause_generation", self.pause)
+        app.router.add_post("/continue_generation", self.resume)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        app.on_startup.append(self.on_startup)
+        app.on_cleanup.append(self.on_cleanup)
+        return app
+
+
+def main():
+    name_resolve.reconfigure_from_env()
+    p = argparse.ArgumentParser()
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--addrs", default="", help="comma-separated backend addrs "
+                   "(default: discover via name_resolve)")
+    p.add_argument("--schedule-policy", default="least_requests")
+    p.add_argument("--train-batch-size", type=int, default=0)
+    p.add_argument("--max-head-offpolicyness", type=int, default=0)
+    p.add_argument("--weights-path", default="")
+    args = p.parse_args()
+    cfg = RouterConfig(
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        schedule_policy=args.schedule_policy,
+        train_batch_size=args.train_batch_size,
+        max_head_offpolicyness=args.max_head_offpolicyness,
+        weights_path=args.weights_path,
+    )
+    router = Router(cfg, addresses=args.addrs.split(",") if args.addrs else None)
+    port = args.port or network.find_free_port()
+    if args.experiment_name:
+        name_resolve.add(
+            names.gen_router(args.experiment_name, args.trial_name),
+            f"{network.gethostip()}:{port}",
+            replace=True,
+        )
+    logger.info(f"router on :{port}")
+    web.run_app(router.app(), port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

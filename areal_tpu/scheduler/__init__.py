@@ -1,0 +1,4 @@
+from areal_tpu.scheduler.rpc_client import RPCEngineClient
+from areal_tpu.scheduler.rpc_server import EngineRPCServer, serve_engine
+
+__all__ = ["EngineRPCServer", "RPCEngineClient", "serve_engine"]

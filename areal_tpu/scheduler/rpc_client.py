@@ -1,0 +1,105 @@
+"""Client side of the engine RPC layer (reference: RPCClient,
+areal/scheduler/rpc/rpc_client.py:17).  Synchronous by design — the
+controller's train loop is sequential; concurrency across workers comes from
+`TrainController` issuing calls on a thread pool."""
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import asdict
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from areal_tpu.api.io_struct import SaveLoadMeta, WeightUpdateMeta
+from areal_tpu.controller.batch import DistributedBatch
+from areal_tpu.scheduler.wire import encode_frame
+
+
+class RPCError(RuntimeError):
+    pass
+
+
+class RPCEngineClient:
+    def __init__(self, addr: str, timeout: float = 3600.0):
+        self.addr = addr
+        self.timeout = timeout
+
+    # ------------------------------ transport ---------------------------
+
+    def call(
+        self,
+        method: str,
+        batch: Optional[Dict[str, Any]] = None,
+        return_batch: bool = False,
+        **kwargs,
+    ):
+        for k, v in list(kwargs.items()):
+            if isinstance(v, (WeightUpdateMeta, SaveLoadMeta)):
+                d = asdict(v)
+                # drop non-wire fields (tokenizer objects, alloc modes)
+                d.pop("tokenizer", None)
+                d.pop("processor", None)
+                d.pop("alloc_mode", None)
+                kwargs[k] = d
+        frame = encode_frame(
+            {"__method__": method, "return_batch": return_batch, **kwargs},
+            DistributedBatch(batch).to_bytes() if batch is not None else b"",
+        )
+        req = urllib.request.Request(
+            f"http://{self.addr}/call", data=frame, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                ctype = resp.headers.get("Content-Type", "")
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:  # noqa: BLE001
+                detail = ""
+            raise RPCError(f"{method} -> HTTP {e.code}: {detail}") from e
+        if "octet-stream" in ctype:
+            out = DistributedBatch.from_bytes(body)
+            d = out.to_dict()
+            if set(d) == {"result"}:
+                return d["result"]
+            return d
+        return json.loads(body).get("result")
+
+    def health(self) -> Dict[str, Any]:
+        with urllib.request.urlopen(
+            f"http://{self.addr}/health", timeout=30
+        ) as resp:
+            return json.loads(resp.read())
+
+    # ------------------------- engine-shaped sugar ----------------------
+
+    def compute_logp(self, batch) -> np.ndarray:
+        return self.call("compute_logp", batch)
+
+    def compute_advantages(self, batch) -> Dict[str, np.ndarray]:
+        """Returns the batch with advantage columns added (server-side
+        mutation shipped back)."""
+        return self.call("compute_advantages", batch, return_batch=True)
+
+    def ppo_update(self, batch):
+        return self.call("ppo_update", batch)
+
+    def update_weights(self, meta: WeightUpdateMeta):
+        return self.call("update_weights", meta=meta)
+
+    def save(self, meta: SaveLoadMeta):
+        return self.call("save", meta=meta)
+
+    def load(self, meta: SaveLoadMeta):
+        return self.call("load", meta=meta)
+
+    def set_version(self, version: int):
+        return self.call("set_version", version=version)
+
+    def get_version(self) -> int:
+        return self.call("get_version")
+
+    def step_lr_scheduler(self):
+        return self.call("step_lr_scheduler")

@@ -1,0 +1,132 @@
+"""HTTP RPC server exposing a train engine to a remote controller.
+
+Behavioral counterpart of the reference's `EngineRPCServer`
+(areal/scheduler/rpc/rpc_server.py:44): the single-controller deployment
+mode where algorithm code runs in ONE controller process and drives N engine
+worker processes over RPC.  The TPU-native shape: each worker process owns a
+jax mesh (its local chips), the wire carries host numpy batches
+(controller/batch.py), and device work runs on a dedicated thread so the
+asyncio loop stays responsive for health checks.
+
+Wire format (POST /call):
+    body   = [8-byte LE kwargs length][kwargs JSON][DistributedBatch npz?]
+    reply  = JSON  (scalar / stats results)
+           | npz blob (array or batch results, content-type octet-stream)
+
+Method dispatch is `getattr(worker, method)`; `update_weights`/`save`/`load`
+re-hydrate their meta dataclasses from kwargs.  `return_batch=True` sends
+the (possibly mutated) batch back — how in-place ops like
+`compute_advantages` cross the wire.
+"""
+
+import asyncio
+import concurrent.futures
+from typing import Any, Optional
+
+import numpy as np
+from aiohttp import web
+
+from areal_tpu.api.io_struct import SaveLoadMeta, WeightUpdateMeta
+from areal_tpu.controller.batch import DistributedBatch
+from areal_tpu.scheduler.wire import decode_frame
+from areal_tpu.utils import logging, name_resolve, names, network
+
+logger = logging.getLogger("rpc.server")
+
+
+class EngineRPCServer:
+    def __init__(self, worker: Any):
+        self.worker = worker
+        # one thread owns all device computation (XLA is not re-entrant from
+        # many host threads the way we'd want; also serializes steps)
+        self._exec = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+    async def call(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        kwargs, blob = decode_frame(body)
+        method = kwargs.pop("__method__")
+        return_batch = kwargs.pop("return_batch", False)
+        batch = DistributedBatch.from_bytes(blob).to_dict() if blob else None
+
+        # re-hydrate meta dataclasses
+        if method == "update_weights" and "meta" in kwargs:
+            kwargs["meta"] = WeightUpdateMeta(**kwargs["meta"])
+        elif method in ("save", "load") and "meta" in kwargs:
+            kwargs["meta"] = SaveLoadMeta(**kwargs["meta"])
+
+        fn = getattr(self.worker, method, None)
+        if fn is None:
+            return web.json_response(
+                {"error": f"no method {method!r}"}, status=404
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            if batch is not None:
+                result = await loop.run_in_executor(
+                    self._exec, lambda: fn(batch, **kwargs)
+                )
+            else:
+                result = await loop.run_in_executor(
+                    self._exec, lambda: fn(**kwargs)
+                )
+        except Exception as e:  # noqa: BLE001 — errors cross the wire as 500s
+            logger.exception(f"rpc call {method} failed")
+            return web.json_response({"error": repr(e)}, status=500)
+
+        if return_batch:
+            blob_out = DistributedBatch(batch).to_bytes()
+            return web.Response(
+                body=blob_out, content_type="application/octet-stream"
+            )
+        if isinstance(result, np.ndarray):
+            blob_out = DistributedBatch({"result": result}).to_bytes()
+            return web.Response(
+                body=blob_out, content_type="application/octet-stream"
+            )
+        if isinstance(result, dict) and any(
+            isinstance(v, np.ndarray) for v in result.values()
+        ):
+            return web.Response(
+                body=DistributedBatch(result).to_bytes(),
+                content_type="application/octet-stream",
+            )
+        return web.json_response({"result": result})
+
+    async def health(self, request: web.Request) -> web.Response:
+        version = None
+        get_version = getattr(self.worker, "get_version", None)
+        if callable(get_version):
+            try:
+                version = get_version()
+            except Exception:  # noqa: BLE001
+                pass
+        return web.json_response(
+            {"status": "ok", "worker": type(self.worker).__name__,
+             "version": version}
+        )
+
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=4 * 1024**3)
+        app.router.add_post("/call", self.call)
+        app.router.add_get("/health", self.health)
+        return app
+
+
+def serve_engine(
+    worker: Any,
+    port: Optional[int] = None,
+    experiment_name: str = "",
+    trial_name: str = "",
+    worker_idx: int = 0,
+):
+    """Blocking serve; registers in name_resolve under workers/rpc_engine."""
+    port = port or network.find_free_port()
+    server = EngineRPCServer(worker)
+    if experiment_name:
+        name_resolve.add(
+            names.worker(experiment_name, trial_name, "rpc_engine", worker_idx),
+            f"{network.gethostip()}:{port}",
+            replace=True,
+        )
+    logger.info(f"engine rpc server on :{port} ({type(worker).__name__})")
+    web.run_app(server.app(), port=port, print=None)

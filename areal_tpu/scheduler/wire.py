@@ -1,0 +1,16 @@
+"""Frame codec shared by the RPC server (aiohttp) and the stdlib-only
+client: [8-byte LE kwargs length][kwargs JSON][DistributedBatch npz?]."""
+
+import json
+import struct
+
+
+def encode_frame(kwargs: dict, batch_blob: bytes = b"") -> bytes:
+    kw = json.dumps(kwargs).encode()
+    return struct.pack("<Q", len(kw)) + kw + batch_blob
+
+
+def decode_frame(body: bytes):
+    (n,) = struct.unpack("<Q", body[:8])
+    kwargs = json.loads(body[8 : 8 + n].decode())
+    return kwargs, body[8 + n :]

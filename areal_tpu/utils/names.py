@@ -49,3 +49,7 @@ def worker_root(experiment_name: str, trial_name: str, worker_type: str) -> str:
 
 def experiment_status(experiment_name: str, trial_name: str) -> str:
     return _join(experiment_name, trial_name, "status")
+
+
+def gen_router(experiment_name: str, trial_name: str) -> str:
+    return _join(experiment_name, trial_name, "gen_router")

@@ -61,15 +61,39 @@ def test_chat_surface_and_cache():
 
 def test_reward_discount_backfill():
     client = ArealOpenAI(_FakeEngine("a"), tokenizer=_Tok())
-    ids = [
-        _chat(client, [{"role": "user", "content": f"turn{i}"}]).id
-        for i in range(3)
-    ]
+    messages = [{"role": "user", "content": "t0"}]
+    ids = []
+    for i in range(3):
+        resp = _chat(client, messages)
+        ids.append(resp.id)
+        messages = messages + [
+            {"role": "assistant", "content": "a"},
+            {"role": "user", "content": f"t{i + 1}"},
+        ]
     client.set_reward(ids[-1], 1.0)
     client.apply_reward_discount(turn_discount=0.5)
     rewards = [client.get_completions(c).reward for c in ids]
-    # reward flows backward with geometric discount: 0.25, 0.5, 1.0
+    # reward flows backward along the turn chain: 0.25, 0.5, 1.0
     np.testing.assert_allclose(rewards, [0.25, 0.5, 1.0])
+
+
+def test_reward_discount_does_not_leak_across_conversations():
+    """Interleaved independent conversations keep their rewards separate
+    (the prefix-tree, not creation order, defines the chains)."""
+    client = ArealOpenAI(_FakeEngine("a"), tokenizer=_Tok())
+    conv_a = [{"role": "user", "content": "A"}]
+    ra1 = _chat(client, conv_a)
+    rb1 = _chat(client, [{"role": "user", "content": "B"}])  # unrelated
+    conv_a2 = conv_a + [
+        {"role": "assistant", "content": "a"},
+        {"role": "user", "content": "A2"},
+    ]
+    ra2 = _chat(client, conv_a2)
+    client.set_reward(ra2.id, 1.0)
+    client.apply_reward_discount(turn_discount=0.5)
+    assert client.get_completions(ra2.id).reward == 1.0
+    assert client.get_completions(ra1.id).reward == 0.5  # parent of ra2
+    assert client.get_completions(rb1.id).reward == 0.0  # isolated
 
 
 def test_concat_export_returns_leaves_only():

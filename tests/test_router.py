@@ -1,0 +1,218 @@
+"""Router service tests against fake backend servers over real HTTP
+(the reference tests GserverManager the same way,
+realhf/tests/system/test_gserver_manager.py:38)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp import web
+
+from areal_tpu.gen.router import Router, RouterConfig
+from areal_tpu.utils import name_resolve, names
+
+from tests.fake_server import FakeGenServer
+
+
+class RouterHarness:
+    """Runs the router app on a background loop like FakeGenServer does."""
+
+    def __init__(self, router: Router):
+        self.router = router
+        self.port = None
+        self._loop = None
+        self._runner = None
+        self._thread = None
+        self._started = threading.Event()
+
+    def start(self) -> str:
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                runner = web.AppRunner(self.router.app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                self._started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(timeout=10)
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        async def _cleanup():
+            await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self._loop).result(timeout=5)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture()
+def fleet():
+    servers = [FakeGenServer(completion=list(range(100, 104))) for _ in range(3)]
+    addrs = [s.start() for s in servers]
+    yield servers, addrs
+    for s in servers:
+        s.stop()
+
+
+def _post(addr, endpoint, payload, expect_status=200):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{addr}{endpoint}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read() or b"{}")
+        assert e.code == expect_status, (e.code, body)
+        return e.code, body
+
+
+def _get(addr, endpoint):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(f"http://{addr}{endpoint}", timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_routing_policies_and_affinity(fleet):
+    servers, addrs = fleet
+    router = Router(RouterConfig(schedule_policy="round_robin"), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # distinct rids round-robin across backends
+        for i in range(6):
+            status, out = _post(
+                raddr,
+                "/generate",
+                {
+                    "rid": f"r{i}",
+                    "input_ids": [1, 2, 3],
+                    "sampling_params": {"max_new_tokens": 16},
+                },
+            )
+            assert status == 200 and out["output_tokens"]
+        counts = [len(s.requests) for s in servers]
+        assert counts == [2, 2, 2], counts
+
+        # same rid sticks to one backend (KV affinity)
+        for _ in range(3):
+            _post(
+                raddr,
+                "/generate",
+                {
+                    "rid": "sticky",
+                    "input_ids": [5],
+                    "sampling_params": {"max_new_tokens": 16},
+                },
+            )
+        counts2 = [len(s.requests) - c for s, c in zip(servers, counts)]
+        assert sorted(counts2) == [0, 0, 3], counts2
+
+        metrics = _get(raddr, "/metrics")
+        assert sum(metrics["tokens_routed"].values()) > 0
+    finally:
+        h.stop()
+
+
+def test_global_staleness_gate(fleet):
+    _, addrs = fleet
+    cfg = RouterConfig(
+        train_batch_size=2, max_head_offpolicyness=0, schedule_policy="round_robin"
+    )
+    router = Router(cfg, addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # version 0: (0 + 0 + 1) * 2 = 2 admissions allowed fleet-wide
+        s1, r1 = _post(raddr, "/allocate_request", {"qid": "a"})
+        s2, r2 = _post(raddr, "/allocate_request", {"qid": "b"})
+        assert s1 == s2 == 200 and not r1["staled"] and not r2["staled"]
+        s3, r3 = _post(raddr, "/allocate_request", {"qid": "c"}, expect_status=409)
+        assert s3 == 409 and r3["staled"]
+
+        # finishing without acceptance frees capacity
+        _post(raddr, "/finish_request", {"qid": "a", "accepted": False})
+        s4, _ = _post(raddr, "/allocate_request", {"qid": "c"})
+        assert s4 == 200
+
+        # accepted samples keep counting against the budget
+        _post(raddr, "/finish_request", {"qid": "b", "accepted": True})
+        s5, _ = _post(raddr, "/allocate_request", {"qid": "d"}, expect_status=409)
+        assert s5 == 409
+    finally:
+        h.stop()
+
+
+def test_manual_weight_update_flushes_fleet(fleet):
+    servers, addrs = fleet
+    router = Router(RouterConfig(), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        status, out = _post(
+            raddr, "/update_weights", {"path": "/dev/null/v7", "version": 7}
+        )
+        assert status == 200 and out["version"] == 7
+        for s in servers:
+            assert len(s.weight_updates) == 1
+            assert s.weight_updates[0]["path"] == "/dev/null/v7"
+            assert s.paused is False  # resumed after the flush
+        health = _get(raddr, "/health")
+        assert health["version"] == 7
+
+        # gate capacity grows with version: (0 + 7 + 1) * bs
+        router.config.train_batch_size = 1
+        for i in range(8):
+            s, _ = _post(raddr, "/allocate_request", {"qid": f"q{i}"})
+            assert s == 200
+        s, _ = _post(raddr, "/allocate_request", {"qid": "overflow"}, expect_status=409)
+        assert s == 409
+    finally:
+        h.stop()
+
+
+def test_checkpoint_watcher_picks_up_trainer_publishes(fleet, tmp_path):
+    servers, addrs = fleet
+    cfg = RouterConfig(
+        experiment_name="rtest",
+        trial_name="t0",
+        weights_path=str(tmp_path),
+        poll_interval=0.05,
+    )
+    router = Router(cfg, addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # trainer publishes version 3 (key layout from jax_train._update_weights_disk)
+        name_resolve.add(
+            names.update_weights_from_disk("rtest", "t0", 3), "123", replace=True
+        )
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and router.version < 3:
+            time.sleep(0.05)
+        assert router.version == 3
+        for s in servers:
+            assert s.weight_updates and s.weight_updates[-1]["path"].endswith("/v3")
+    finally:
+        h.stop()

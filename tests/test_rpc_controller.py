@@ -1,0 +1,199 @@
+"""RPC controller layer: engine workers behind HTTP, driven by a
+single-controller process (reference: areal/scheduler/rpc/ +
+areal/controller/ single-controller mode)."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    NormConfig,
+    OptimizerConfig,
+    PPOActorConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.controller import DistributedBatch, TrainController
+from areal_tpu.engine.ppo import JaxPPOActor
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.scheduler import EngineRPCServer, RPCEngineClient
+from areal_tpu.scheduler.rpc_client import RPCError
+
+MODEL_CFG = tiny_config(vocab_size=64, qkv_bias=True, hf_architecture="Qwen2ForCausalLM")
+
+
+def _actor(group_size=4):
+    cfg = PPOActorConfig(
+        experiment_name="rpc",
+        trial_name="t",
+        init_from_scratch=True,
+        dtype="float32",
+        gradient_checkpointing=False,
+        mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+        optimizer=OptimizerConfig(
+            lr=5e-3, warmup_steps_proportion=0.0, weight_decay=0.0
+        ),
+        pack_length_quantum=16,
+        group_size=group_size,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(
+            mean_level="group", std_level="group", group_size=group_size
+        ),
+    )
+    actor = JaxPPOActor(cfg, model_config=MODEL_CFG)
+    actor.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    return actor
+
+
+def _batch(rng, B=8, L=16, prompt_len=4):
+    ids = rng.integers(0, MODEL_CFG.vocab_size, (B, L)).astype(np.int32)
+    loss_mask = np.zeros((B, L), np.float32)
+    loss_mask[:, prompt_len:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": np.ones((B, L), bool),
+        "loss_mask": loss_mask,
+        "logprobs": rng.normal(-1.0, 0.1, (B, L)).astype(np.float32) * loss_mask,
+        "rewards": (ids[:, prompt_len] % 2 == 0).astype(np.float32),
+        "versions": np.zeros((B, L), np.int32),
+    }
+
+
+class ServerHarness:
+    def __init__(self, worker):
+        self.server = EngineRPCServer(worker)
+        self._started = threading.Event()
+        self.port = None
+
+    def start(self) -> str:
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                runner = web.AppRunner(self.server.app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                self._started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(timeout=10)
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        async def _cleanup():
+            await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self._loop).result(timeout=5)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+def test_distributed_batch_roundtrip_chunk_union():
+    rng = np.random.default_rng(0)
+    b = DistributedBatch(
+        {
+            "input_ids": rng.integers(0, 64, (6, 8)).astype(np.int32),
+            "attention_mask": np.ones((6, 8), bool),
+            "rewards": rng.normal(size=6).astype(np.float32),
+            "note": {"task": "math"},
+        }
+    )
+    blob = b.to_bytes()
+    back = DistributedBatch.from_bytes(blob)
+    assert set(back.arrays) == set(b.arrays)
+    np.testing.assert_array_equal(back["input_ids"], b["input_ids"])
+    assert back.meta == {"note": {"task": "math"}}
+
+    shards = back.chunk(4)
+    assert [len(s) for s in shards] == [1, 2, 1, 2]
+    merged = DistributedBatch.concat(shards)
+    np.testing.assert_array_equal(merged["input_ids"], b["input_ids"])
+
+    extra = DistributedBatch({"advantages": rng.normal(size=(6, 8)).astype(np.float32)})
+    joined = merged.union(extra)
+    assert "advantages" in joined and "input_ids" in joined
+
+    with pytest.raises(ValueError):
+        back.chunk(7)
+
+    # quantum keeps group boundaries intact: 6 rows, groups of 2, 3 shards
+    for shard in back.chunk(3, quantum=2):
+        assert len(shard) == 2
+    with pytest.raises(ValueError):
+        back.chunk(2, quantum=4)  # 6 % 4 != 0
+
+
+def test_rpc_engine_roundtrip():
+    actor = _actor()
+    h = ServerHarness(actor)
+    addr = h.start()
+    try:
+        client = RPCEngineClient(addr)
+        assert client.health()["status"] == "ok"
+        rng = np.random.default_rng(1)
+        batch = _batch(rng)
+
+        logp = client.compute_logp(batch)
+        local = actor.compute_logp(batch)
+        np.testing.assert_allclose(logp, local, rtol=1e-5, atol=1e-5)
+
+        batch["prox_logp"] = logp
+        out = client.compute_advantages(batch)
+        assert "advantages" in out
+        batch.update(out)
+
+        stats = client.ppo_update(batch)
+        assert stats and np.isfinite(stats[-1]["loss"])
+
+        client.set_version(3)
+        assert client.get_version() == 3
+
+        with pytest.raises(RPCError):
+            client.call("no_such_method")
+    finally:
+        h.stop()
+        actor.destroy()
+
+
+def test_train_controller_two_workers():
+    actors = [_actor(group_size=2), _actor(group_size=2)]
+    harnesses = [ServerHarness(a) for a in actors]
+    addrs = [h.start() for h in harnesses]
+    try:
+        ctl = TrainController(
+            [RPCEngineClient(a) for a in addrs], chunk_quantum=2
+        )
+        rng = np.random.default_rng(2)
+        batch = _batch(rng, B=8)
+
+        logp = ctl.compute_logp(batch)
+        assert logp.shape == batch["input_ids"].shape
+
+        batch["prox_logp"] = logp
+        ctl.compute_advantages(batch)
+        assert "advantages" in batch
+
+        stats = ctl.ppo_update(batch)
+        assert stats and np.isfinite(stats[-1]["loss"])
+
+        ctl.set_version(5)
+        assert ctl.get_version() == 5
+        assert all(h["status"] == "ok" for h in ctl.health())
+    finally:
+        for h in harnesses:
+            h.stop()
+        for a in actors:
+            a.destroy()
