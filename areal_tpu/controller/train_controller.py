@@ -21,14 +21,17 @@ def _merge_stats(
     per_worker: Sequence[List[Dict[str, float]]],
     weights: Sequence[float],
 ) -> List[Dict[str, float]]:
-    """Average each minibatch-step's stats dict across workers, weighted by
-    each worker's shard size so uneven shards don't bias the metrics."""
+    """Average each minibatch-step's stats dict across workers.  Engine
+    stats are per-token means (actor.py normalizes by n_valid_tokens and
+    reports it as 'n_tokens'), so weight by the step's token count when
+    present; fall back to the worker's shard rows otherwise."""
     n_steps = max(len(w) for w in per_worker)
     out = []
     for i in range(n_steps):
         acc: Dict[str, List[tuple]] = {}
-        for w, wt in zip(per_worker, weights):
+        for w, rows in zip(per_worker, weights):
             if i < len(w):
+                wt = float(w[i].get("n_tokens", rows))
                 for k, v in w[i].items():
                     if isinstance(v, (int, float)):
                         acc.setdefault(k, []).append((float(v), wt))

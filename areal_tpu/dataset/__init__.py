@@ -35,3 +35,6 @@ def get_custom_dataset(
 from areal_tpu.dataset import gsm8k as _gsm8k  # noqa: E402,F401  (registers)
 from areal_tpu.dataset import jsonl as _jsonl  # noqa: E402,F401
 from areal_tpu.dataset import clevr as _clevr  # noqa: E402,F401
+from areal_tpu.dataset import geometry3k as _geometry3k  # noqa: E402,F401
+from areal_tpu.dataset import hhrlhf as _hhrlhf  # noqa: E402,F401
+from areal_tpu.dataset import torl as _torl  # noqa: E402,F401

@@ -1,0 +1,72 @@
+"""Geometry3K visual math-RL dataset (reference:
+areal/dataset/geometry3k.py get_geometry3k_rl_dataset).
+
+Rows: {"images", "messages", "answer", "query_id"} feeding
+VisionRLVRWorkflow, same shape as the CLEVR loader (dataset/clevr.py).
+Images are padded to square RGB before reaching the processor — geometry
+diagrams are extreme-aspect-ratio and vision towers expect near-square
+crops (reference pad_to_square, geometry3k.py:10).  Offline-friendly: a
+jsonl manifest with image paths, or an HF dataset dir.
+"""
+
+import json
+import os
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+
+def pad_to_square(img, fill=(0, 0, 0)):
+    from PIL import Image
+
+    w, h = img.size
+    if w == h:
+        return img
+    side = max(w, h)
+    out = Image.new("RGB" if img.mode != "RGB" else img.mode, (side, side), fill)
+    out.paste(img, ((side - w) // 2, (side - h) // 2))
+    return out
+
+
+@register_dataset("geometry3k")
+def get_geometry3k_rl_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    processor=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    """jsonl manifest rows: {"images": [path...] | "image": path,
+    "messages": str | chat list, "answer": str} (keys mirror the
+    reference's image/problem/answer columns)."""
+    manifest = path
+    if os.path.isdir(path):
+        manifest = os.path.join(path, f"{split}.jsonl")
+    samples = []
+    base = os.path.dirname(os.path.abspath(manifest))
+    with open(manifest) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            images = row.get("images") or [row["image"]]
+            images = [
+                img if not isinstance(img, str) or os.path.isabs(img)
+                else os.path.join(base, img)
+                for img in images
+            ]
+            messages = row.get("messages", row.get("problem"))
+            sample = {
+                "images": images,
+                "messages": messages,
+                "answer": str(row["answer"]),
+                "query_id": str(row.get("query_id", i)),
+                "image_transform": "pad_to_square",
+            }
+            if "input_ids" in row:
+                sample["input_ids"] = row["input_ids"]
+                if max_length and len(sample["input_ids"]) > max_length:
+                    continue
+            samples.append(sample)
+    return samples

@@ -1,0 +1,48 @@
+"""Anthropic HH-RLHF preference-pair dataset for reward-model training
+(reference: areal/dataset/hhrlhf.py get_hhrlhf_rw_dataset).
+
+Rows become {"chosen_ids", "rejected_ids"} token lists — the pairwise
+format the Bradley-Terry RW engine consumes (engine/rw/rw_engine.py
+interleaves them chosen/rejected).  Offline-friendly: accepts a jsonl file
+with {"chosen": str, "rejected": str} rows as well as an HF dataset id.
+"""
+
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+
+@register_dataset("hhrlhf")
+def get_hhrlhf_rw_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    if tokenizer is None:
+        raise ValueError("hhrlhf needs a tokenizer to build preference pairs")
+    import datasets as hf_datasets
+
+    if path.endswith(".jsonl") or path.endswith(".json"):
+        ds = hf_datasets.load_dataset("json", data_files=path, split="train")
+    else:
+        ds = hf_datasets.load_dataset(path, split=split)
+
+    eos = tokenizer.eos_token or ""
+
+    def process(sample):
+        return {
+            "chosen_ids": tokenizer.encode(sample["chosen"] + eos),
+            "rejected_ids": tokenizer.encode(sample["rejected"] + eos),
+        }
+
+    ds = ds.map(process, remove_columns=[
+        c for c in ds.column_names if c in ("chosen", "rejected")
+    ])
+    if max_length is not None:
+        ds = ds.filter(
+            lambda x: len(x["chosen_ids"]) <= max_length
+            and len(x["rejected_ids"]) <= max_length
+        )
+    return ds

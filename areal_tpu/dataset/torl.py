@@ -1,0 +1,61 @@
+"""ToRL tool-integrated math-RL dataset (reference:
+areal/dataset/torl_data.py get_torl_data_rl_dataset).
+
+The reference downloads the GAIR-NLP/ToRL parquet files at runtime; this
+environment has no egress, so `path` must point at a local parquet/jsonl
+copy.  Rows keep the reference's mapping: the ground-truth answer is
+wrapped in \\boxed{} so the math verifier's boxed-answer path applies.
+"""
+
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+
+@register_dataset("torl")
+def get_torl_rl_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    import datasets as hf_datasets
+
+    if path.endswith(".parquet"):
+        ds = hf_datasets.load_dataset("parquet", data_files=path, split="train")
+    elif path.endswith(".jsonl") or path.endswith(".json"):
+        ds = hf_datasets.load_dataset("json", data_files=path, split="train")
+    else:
+        ds = hf_datasets.load_dataset(path, split=split)
+
+    def process(sample, idx):
+        if "reward_model" in sample:  # the upstream parquet schema
+            answer = sample["reward_model"]["ground_truth"]
+            messages = sample["prompt"]
+        else:  # pre-converted jsonl
+            answer = sample["answer"]
+            messages = sample["messages"]
+        return {
+            "messages": messages,
+            "answer": f"\\boxed{{{answer}}}",
+            "query_id": str(sample.get("query_id", idx)),
+        }
+
+    drop = [
+        c for c in ds.column_names
+        if c in ("prompt", "reward_model", "data_source", "ability", "extra_info")
+    ]
+    ds = ds.map(process, with_indices=True, remove_columns=drop)
+    if max_length is not None and tokenizer is not None:
+        ds = ds.filter(
+            lambda x: len(
+                tokenizer.apply_chat_template(
+                    x["messages"], add_generation_prompt=True, tokenize=True
+                )
+                if isinstance(x["messages"], list)
+                else tokenizer.encode(x["messages"])
+            )
+            <= max_length
+        )
+    return ds

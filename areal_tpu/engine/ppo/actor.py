@@ -46,6 +46,12 @@ def _roll_back(arr: np.ndarray) -> np.ndarray:
 class PPOActor:
     """Algorithm layer over any TrainEngine (reference: actor.py:25)."""
 
+    # batch keys forwarded into the jitted loss; recipe subclasses extend
+    LOSS_KEYS = (
+        "input_ids", "attention_mask", "loss_mask", "logprobs",
+        "advantages", "prox_logp",
+    )
+
     def __init__(self, config: PPOActorConfig, engine):
         self.config = config
         self.engine = engine
@@ -211,11 +217,7 @@ class PPOActor:
 
                 batch = select_rows(batch, keep)
 
-        loss_keys = [
-            "input_ids", "attention_mask", "loss_mask", "logprobs",
-            "advantages", "prox_logp",
-        ]
-        train_view = {k: batch[k] for k in loss_keys if k in batch}
+        train_view = {k: batch[k] for k in self.LOSS_KEYS if k in batch}
         mbs = split_padded_tensor_dict_into_mb_list(
             train_view, n_mbs=cfg.ppo_n_minibatches
         )

@@ -196,19 +196,16 @@ class ArealOpenAI:
         prefix rule as export_completions, so interleaved independent
         conversations never leak reward into each other."""
         ordered = sorted(self._cache.values(), key=lambda c: c.created)
-        full = {c.id: c.messages + [{"role": "assistant", "content": c.text}]
-                for c in ordered}
         parent: Dict[str, CompletionWithTokenLogpReward] = {}
         for b in ordered:
             best = None
             for a in ordered:
-                if a is b or len(full[a.id]) > len(b.messages):
+                if a is b or not _is_prefix_ancestor(a, b):
                     continue
-                if full[a.id] == b.messages[: len(full[a.id])]:
-                    # deepest ancestor wins; among equal-depth duplicates
-                    # (re-sampled identical turns) prefer the latest created
-                    if best is None or len(a.messages) >= len(best.messages):
-                        best = a
+                # deepest ancestor wins; among equal-depth duplicates
+                # (re-sampled identical turns) prefer the latest created
+                if best is None or len(a.messages) >= len(best.messages):
+                    best = a
             if best is not None:
                 parent[b.id] = best
         for comp in ordered:

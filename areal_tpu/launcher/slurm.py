@@ -1,0 +1,256 @@
+"""Slurm launcher: sbatch-script generation + submit/babysit/cancel.
+
+Behavioral counterpart of the reference's `SlurmLauncher`
+(areal/launcher/slurm.py:46; sbatch generation :93-267): the experiment is
+submitted as two Slurm jobs — a generation-server job array and one
+multi-task trainer job — wired together through the shared-filesystem
+name_resolve store.  TPU-first differences: tasks request
+`--gres=tpu:N`-style generic resources instead of GPUs, and the trainer
+tasks join one jax.distributed runtime via the AREAL_COORDINATOR /
+AREAL_NUM_PROCESSES / AREAL_PROCESS_ID contract (parallel/distributed.py)
+with SLURM_PROCID providing the process id.
+
+All slurm binaries are injectable (`sbatch_bin`, ...) so the launcher is
+testable on machines without Slurm (the reference tests its sbatch
+rendering the same way).
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("launcher.slurm")
+
+TERMINAL_STATES = {
+    "COMPLETED", "FAILED", "CANCELLED", "TIMEOUT", "NODE_FAIL",
+    "PREEMPTED", "OUT_OF_MEMORY",
+}
+COORDINATOR_PORT = 20025
+
+
+@dataclass
+class SlurmJobSpec:
+    job_name: str
+    cmd: str
+    n_tasks: int = 1
+    tasks_per_node: int = 1
+    cpus_per_task: int = 8
+    mem_per_task_mb: int = 32768
+    gres: str = ""  # e.g. "tpu:1"
+    partition: str = ""
+    account: str = ""
+    time_limit: str = ""
+    container: str = ""  # apptainer/singularity image (reference srun wraps)
+    env: Dict[str, str] = field(default_factory=dict)  # static, quoted
+    # exported inside each srun task UNQUOTED so $VARS and $(cmds) expand
+    # per-task at runtime (e.g. the coordinator-host lookup)
+    runtime_env: Dict[str, str] = field(default_factory=dict)
+    log_path: str = "slurm-%j.out"
+
+
+def render_sbatch(spec: SlurmJobSpec) -> str:
+    """One sbatch script per job; srun fans the command across tasks with
+    SLURM_PROCID exported as the process id (reference slurm.py:93-267)."""
+    lines = [
+        "#!/bin/bash",
+        f"#SBATCH --job-name={spec.job_name}",
+        f"#SBATCH --ntasks={spec.n_tasks}",
+        f"#SBATCH --ntasks-per-node={spec.tasks_per_node}",
+        f"#SBATCH --cpus-per-task={spec.cpus_per_task}",
+        f"#SBATCH --mem-per-cpu="
+        f"{max(1, spec.mem_per_task_mb // max(1, spec.cpus_per_task))}M",
+        f"#SBATCH --output={spec.log_path}",
+        "#SBATCH --open-mode=append",
+    ]
+    if spec.gres:
+        lines.append(f"#SBATCH --gres={spec.gres}")
+    if spec.partition:
+        lines.append(f"#SBATCH --partition={spec.partition}")
+    if spec.account:
+        lines.append(f"#SBATCH --account={spec.account}")
+    if spec.time_limit:
+        lines.append(f"#SBATCH --time={spec.time_limit}")
+    lines.append("")
+    for k, v in spec.env.items():
+        lines.append(f"export {k}={shlex.quote(v)}")
+    lines.append("")
+    # per-task setup must run INSIDE the srun'd shell: at batch-script level
+    # SLURM_PROCID is 0 and command substitutions would be expanded once for
+    # all tasks; inside `bash -c '...'` each task expands them itself
+    per_task = ["export AREAL_PROCESS_ID=$SLURM_PROCID"]
+    for k, v in spec.runtime_env.items():
+        per_task.append(f"export {k}={v}")  # deliberately unquoted: expands
+    inner = "; ".join(per_task + [spec.cmd])
+    if spec.container:
+        inner = (
+            f"apptainer exec --bind {shlex.quote(os.getcwd())} "
+            f"{shlex.quote(spec.container)} bash -c {shlex.quote(inner)}"
+        )
+    lines.append(f"srun --kill-on-bad-exit=1 bash -c {shlex.quote(inner)}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+class SlurmLauncher:
+    def __init__(
+        self,
+        entry: str,
+        config_args: List[str],
+        n_gen_servers: int,
+        n_train_procs: int,
+        sbatch_bin: str = "sbatch",
+        squeue_bin: str = "squeue",
+        scancel_bin: str = "scancel",
+        workdir: Optional[str] = None,
+    ):
+        self.entry = entry
+        self.config_args = config_args
+        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.n_gen_servers = n_gen_servers
+        self.n_train_procs = n_train_procs
+        self.sbatch_bin = sbatch_bin
+        self.squeue_bin = squeue_bin
+        self.scancel_bin = scancel_bin
+        self.workdir = workdir or os.getcwd()
+        self.job_ids: List[str] = []
+        nr = self.config.cluster.name_resolve
+        if nr.type != "nfs":
+            raise ValueError(
+                "slurm runs need cluster.name_resolve.type=nfs on a path "
+                "visible from every node"
+            )
+        self._common_env = {
+            "AREAL_NAME_RESOLVE": f"nfs:{nr.nfs_record_root}",
+        }
+        self._script_dir = os.path.join(
+            self.config.cluster.fileroot,
+            self.config.experiment_name,
+            self.config.trial_name,
+            "slurm",
+        )
+
+    # ----------------------------- job specs ----------------------------
+
+    def gen_server_spec(self) -> SlurmJobSpec:
+        g = self.config.gen_server
+        from areal_tpu.api.config import GenServerConfig
+
+        cmd = (
+            GenServerConfig.build_cmd(g, host="$(hostname -i)", port=0)
+            + f" --experiment-name {shlex.quote(self.config.experiment_name)}"
+            + f" --trial-name {shlex.quote(self.config.trial_name)}"
+            + " --server-idx $SLURM_PROCID"
+        )
+        return SlurmJobSpec(
+            job_name=f"{self.config.experiment_name}-gen",
+            cmd=cmd,
+            n_tasks=self.n_gen_servers,
+            gres="tpu:1",
+            env=dict(self._common_env),
+            log_path=os.path.join(self._script_dir, "gen_%j_%t.log"),
+        )
+
+    def trainer_spec(self, run_id: int = 0) -> SlurmJobSpec:
+        cmd = (
+            f"{shlex.quote(sys.executable)} {shlex.quote(self.entry)} "
+            + " ".join(shlex.quote(a) for a in self.config_args)
+        )
+        env = dict(self._common_env)
+        env.update(
+            AREAL_RUN_ID=str(run_id),
+            AREAL_NUM_PROCESSES=str(self.n_train_procs),
+        )
+        return SlurmJobSpec(
+            job_name=f"{self.config.experiment_name}-train",
+            cmd=cmd,
+            n_tasks=self.n_train_procs,
+            gres="tpu:4",
+            env=env,
+            runtime_env={
+                # trainer task 0's node hosts the jax.distributed
+                # coordinator; resolved per task inside srun so the
+                # substitution actually runs
+                "AREAL_COORDINATOR": (
+                    "$(scontrol show hostnames $SLURM_JOB_NODELIST | head -n1):"
+                    f"{COORDINATOR_PORT + run_id}"
+                ),
+            },
+            log_path=os.path.join(self._script_dir, "train_%j_%t.log"),
+        )
+
+    # ----------------------------- lifecycle ----------------------------
+
+    def submit(self, spec: SlurmJobSpec) -> str:
+        os.makedirs(self._script_dir, exist_ok=True)
+        path = os.path.join(self._script_dir, f"{spec.job_name}.sbatch")
+        with open(path, "w") as f:
+            f.write(render_sbatch(spec))
+        out = subprocess.run(
+            [self.sbatch_bin, "--parsable", path],
+            capture_output=True,
+            text=True,
+            cwd=self.workdir,
+            check=True,
+        )
+        job_id = out.stdout.strip().split(";")[0]
+        self.job_ids.append(job_id)
+        logger.info(f"submitted {spec.job_name} as job {job_id}")
+        return job_id
+
+    def job_state(self, job_id: str) -> str:
+        out = subprocess.run(
+            [self.squeue_bin, "-j", job_id, "-h", "-o", "%T"],
+            capture_output=True,
+            text=True,
+        )
+        state = out.stdout.strip().splitlines()
+        return state[0].strip() if state else "COMPLETED"
+
+    def cancel_all(self):
+        for job_id in self.job_ids:
+            subprocess.run([self.scancel_bin, job_id], capture_output=True)
+        self.job_ids.clear()
+
+    def run(self, poll_interval: float = 10.0) -> int:
+        """Submit both jobs and babysit: trainer completion ends the run;
+        either job failing cancels the other (the reference's all-or-nothing
+        worker semantics)."""
+        try:
+            gen_id = self.submit(self.gen_server_spec()) if self.n_gen_servers else None
+            train_id = self.submit(self.trainer_spec())
+            while True:
+                t_state = self.job_state(train_id)
+                if t_state in TERMINAL_STATES:
+                    return 0 if t_state == "COMPLETED" else 1
+                if gen_id is not None:
+                    g_state = self.job_state(gen_id)
+                    if g_state in TERMINAL_STATES and g_state != "COMPLETED":
+                        logger.error(f"gen-server job {gen_id}: {g_state}")
+                        return 1
+                time.sleep(poll_interval)
+        finally:
+            self.cancel_all()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("entry")
+    parser.add_argument("--n-gen-servers", type=int, default=1)
+    parser.add_argument("--n-train-procs", type=int, default=1)
+    args, config_args = parser.parse_known_args()
+    launcher = SlurmLauncher(
+        args.entry, config_args, args.n_gen_servers, args.n_train_procs
+    )
+    sys.exit(launcher.run())
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,34 @@ from typing import Optional
 
 
 @dataclass(frozen=True)
+class VisionConfig:
+    """ViT vision tower (Qwen2-VL family shape: patchified pixels in,
+    spatially-merged embeddings at the text width out)."""
+
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    in_channels: int = 3
+    hidden_size: int = 1280
+    intermediate_size: int = 5120
+    num_layers: int = 32
+    num_heads: int = 16
+    spatial_merge_size: int = 2  # 2x2 patches -> one embedding
+    out_hidden_size: int = 4096  # text model width
+    rms_norm_eps: float = 1e-6
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size**2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def replace(self, **kw) -> "VisionConfig":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
     hidden_size: int = 4096
@@ -54,6 +82,12 @@ class TransformerConfig:
     # attention implementation: "auto" picks the Pallas splash kernel on TPU
     # when shapes allow and the naive einsum path elsewhere (ops/attention.py)
     attn_impl: str = "auto"  # auto | splash | naive
+
+    # vision-language (None = text-only); Qwen2-VL-style mrope: the rope
+    # frequency bands are split into (temporal, height, width) sections
+    vision: Optional[VisionConfig] = None
+    image_token_id: Optional[int] = None
+    mrope_section: Optional[tuple] = None  # e.g. (16, 24, 24); sums to hd/2
 
     # bookkeeping
     hf_architecture: str = "LlamaForCausalLM"

@@ -134,6 +134,8 @@ def _backbone(
     positions: jax.Array,
     segment_ids: jax.Array,
     mesh: Optional[Mesh] = None,
+    inputs_embeds: Optional[jax.Array] = None,  # [B, T, D] (VLM merge)
+    rope: Optional[tuple] = None,  # (cos, sin) override (mrope)
 ):
     """Layer scan -> (final-norm hidden [B, T, D], summed MoE aux loss)."""
     if cfg.lora_rank:
@@ -142,8 +144,13 @@ def _backbone(
 
         params = freeze_base(params, True)
     dtype = jnp.dtype(cfg.dtype)
-    x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+    if inputs_embeds is not None:
+        x = inputs_embeds.astype(dtype)
+    else:
+        x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+    cos, sin = rope if rope is not None else rope_cos_sin(
+        positions, cfg.head_dim_, cfg.rope_theta
+    )
 
     B, T = input_ids.shape
     sp = mesh.shape["sp"] if mesh is not None else 1

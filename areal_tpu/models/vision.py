@@ -1,0 +1,238 @@
+"""Vision tower + multimodal rope for VLM training.
+
+Behavioral counterpart of the reference's VLM path (lite loads
+AutoModelForImageTextToText and builds qwen2-VL mrope position ids,
+areal/engine/base_hf_engine.py:261-287; vision episodes flow through
+workflow/vision_rlvr.py).  TPU-first shape:
+
+- the tower is a pure-function ViT over *pre-patchified* pixels
+  [n_patches, C*tps*ps*ps] (the qwen2-VL wire format the AutoProcessor
+  emits) — patch embedding is one matmul, blocks are bidirectional
+  attention **within each image** (image ids double as attention segments),
+  and a spatial-merge MLP emits embeddings at the text width;
+- merged image embeddings are scattered into the text embedding stream at
+  the image-placeholder token positions with a static-shape cumsum gather
+  (no dynamic shapes under jit);
+- mrope: 3-row (temporal, h, w) position ids drive rope, with the frequency
+  bands split per `cfg.mrope_section`; attention masking keeps using the
+  1-D text positions, so causality is untouched.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.model_config import TransformerConfig, VisionConfig
+from areal_tpu.models.transformer import (
+    LMOutput,
+    _backbone,
+    rms_norm,
+)
+
+Params = Dict
+
+
+# ---------------------------------------------------------------------------
+# Vision tower
+# ---------------------------------------------------------------------------
+
+
+def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> Params:
+    k = jax.random.split(key, 8)
+    D, I = cfg.hidden_size, cfg.intermediate_size
+    merged = D * cfg.spatial_merge_size**2
+
+    def init(kk, *shape):
+        # fan-in scaling; for stacked per-layer weights [L, in, out] the
+        # fan-in is the second-to-last dim, not the layer-stack dim
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
+        return (
+            jax.random.normal(kk, shape, dtype) / np.sqrt(fan_in)
+        ).astype(dtype)
+
+    L = cfg.num_layers
+    return {
+        "patch_embed": init(k[0], cfg.patch_dim, D),
+        "layers": {
+            "input_norm": jnp.ones((L, D), dtype),
+            "wqkv": init(k[1], L, D, 3 * D) * np.sqrt(1.0 / 3),
+            "wo": init(k[2], L, D, D),
+            "post_attn_norm": jnp.ones((L, D), dtype),
+            "w_up": init(k[3], L, D, I),
+            "w_gate": init(k[4], L, D, I),
+            "w_down": init(k[5], L, I, D),
+        },
+        "merger_norm": jnp.ones((D,), dtype),
+        "merger_fc1": init(k[6], merged, merged),
+        "merger_fc2": init(k[7], merged, cfg.out_hidden_size),
+    }
+
+
+def _vit_layer(cfg: VisionConfig, lp: Params, x: jax.Array, img_ids: jax.Array):
+    """One bidirectional block over [N, D] patches; attention only within
+    the same image (img_ids [N], -1 = padding)."""
+    N, D = x.shape
+    H, hd = cfg.num_heads, cfg.head_dim
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    qkv = (h @ lp["wqkv"].astype(x.dtype)).reshape(N, 3, H, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    scores = jnp.einsum("nhd,mhd->hnm", q, k).astype(jnp.float32) / np.sqrt(hd)
+    mask = (img_ids[:, None] == img_ids[None, :]) & (img_ids[:, None] >= 0)
+    scores = jnp.where(mask[None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("hnm,mhd->nhd", probs, v).reshape(N, D)
+    x = x + attn @ lp["wo"].astype(x.dtype)
+    h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    up = h @ lp["w_up"].astype(x.dtype)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
+    return x + (up * gate) @ lp["w_down"].astype(x.dtype)
+
+
+def vision_forward(
+    params: Params,
+    cfg: VisionConfig,
+    pixel_values: jax.Array,  # [N, patch_dim] pre-patchified
+    img_ids: jax.Array,  # int32 [N]: image index per patch, -1 padding
+) -> jax.Array:
+    """-> merged embeddings [N // merge^2, out_hidden_size].
+
+    Patches must arrive row-major per image with h, w divisible by the
+    merge size (the qwen2-VL processor guarantees this), so consecutive
+    groups of merge^2 patches form one output embedding."""
+    dtype = pixel_values.dtype
+    x = pixel_values @ params["patch_embed"].astype(dtype)
+
+    def body(x, lp):
+        return _vit_layer(cfg, lp, x, img_ids), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["merger_norm"], cfg.rms_norm_eps)
+    m2 = cfg.spatial_merge_size**2
+    x = x.reshape(x.shape[0] // m2, m2 * cfg.hidden_size)
+    x = jax.nn.gelu(x @ params["merger_fc1"].astype(dtype))
+    return x @ params["merger_fc2"].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# mrope
+# ---------------------------------------------------------------------------
+
+
+def mrope_position_ids(
+    input_ids: np.ndarray,  # int [T] one sequence
+    image_grid_thw: np.ndarray,  # int [n_img, 3] (t, h, w) in patches
+    image_token_id: int,
+    spatial_merge_size: int = 2,
+) -> np.ndarray:
+    """Host-side 3xT (temporal, h, w) position ids, qwen2-VL scheme
+    (reference: base_hf_engine.py:261-287 position-id construction):
+    text tokens advance all three rows together; each image's placeholder
+    span gets (t, row, col) grid coordinates offset from the running
+    position; text resumes at max(position)+1."""
+    T = len(input_ids)
+    out = np.zeros((3, T), np.int64)
+    img_idx = 0
+    pos = 0  # next position value for text
+    t = 0
+    while t < T:
+        if input_ids[t] == image_token_id:
+            gt, gh, gw = (int(v) for v in image_grid_thw[img_idx])
+            mh, mw = gh // spatial_merge_size, gw // spatial_merge_size
+            n = gt * mh * mw
+            tt, hh, ww = np.meshgrid(
+                np.arange(gt), np.arange(mh), np.arange(mw), indexing="ij"
+            )
+            out[0, t : t + n] = pos + tt.reshape(-1)
+            out[1, t : t + n] = pos + hh.reshape(-1)
+            out[2, t : t + n] = pos + ww.reshape(-1)
+            pos = pos + max(gt, mh, mw)
+            t += n
+            img_idx += 1
+        else:
+            out[:, t] = pos
+            pos += 1
+            t += 1
+    return out
+
+
+def mrope_cos_sin(
+    positions3: jax.Array,  # int [3, B, T]
+    head_dim: int,
+    theta: float,
+    section: Tuple[int, int, int],
+):
+    """cos/sin [B, T, hd/2] with frequency bands picked per mrope section:
+    the first section[0] bands use the temporal row, the next section[1] the
+    height row, the last section[2] the width row."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions3.astype(jnp.float32)[..., None] * inv_freq  # [3,B,T,hd/2]
+    sec_id = jnp.repeat(
+        jnp.arange(3), jnp.asarray(section), total_repeat_length=head_dim // 2
+    )  # [hd/2] in {0,1,2}
+    # advanced indexing at axes (0, 3) -> [hd/2, B, T]; restore [B, T, hd/2]
+    picked = angles[sec_id, ..., jnp.arange(head_dim // 2)]
+    picked = jnp.moveaxis(picked, 0, -1)
+    return jnp.cos(picked), jnp.sin(picked)
+
+
+# ---------------------------------------------------------------------------
+# VLM forward
+# ---------------------------------------------------------------------------
+
+
+def merge_image_embeds(
+    text_embeds: jax.Array,  # [B, T, D]
+    input_ids: jax.Array,  # [B, T]
+    image_embeds: jax.Array,  # [M, D] merged vision embeddings, in order
+    image_token_id: int,
+) -> jax.Array:
+    """Replace placeholder-token embeddings with image embeddings, in
+    scan order — static shapes throughout (cumsum gather, no boolean
+    indexing)."""
+    B, T, D = text_embeds.shape
+    mask = (input_ids == image_token_id).reshape(-1)
+    idx = jnp.cumsum(mask) - 1  # position among placeholder tokens
+    M = image_embeds.shape[0]
+    gathered = jnp.take(
+        image_embeds, jnp.clip(idx, 0, M - 1), axis=0
+    ).astype(text_embeds.dtype)
+    flat = jnp.where(mask[:, None], gathered, text_embeds.reshape(-1, D))
+    return flat.reshape(B, T, D)
+
+
+def forward_vlm_lm(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T] text-index positions (masking/causality)
+    segment_ids: jax.Array,  # [B, T]
+    pixel_values: jax.Array,  # [N, patch_dim]
+    patch_img_ids: jax.Array,  # [N] image index per patch (-1 pad)
+    mrope_positions: Optional[jax.Array] = None,  # [3, B, T]
+    mesh=None,
+) -> LMOutput:
+    """VLM forward with deferred LM head (mirrors transformer.forward_lm)."""
+    assert cfg.vision is not None and cfg.image_token_id is not None
+    dtype = jnp.dtype(cfg.dtype)
+    text = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+    vis = vision_forward(
+        params["vision"], cfg.vision, pixel_values.astype(dtype), patch_img_ids
+    )
+    x = merge_image_embeds(text, input_ids, vis, cfg.image_token_id)
+    rope = None
+    if mrope_positions is not None and cfg.mrope_section is not None:
+        rope = mrope_cos_sin(
+            mrope_positions, cfg.head_dim_, cfg.rope_theta, cfg.mrope_section
+        )
+    hidden, aux = _backbone(
+        params, cfg, input_ids, positions, segment_ids,
+        mesh=mesh, inputs_embeds=x, rope=rope,
+    )
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    return LMOutput(hidden=hidden, head=head.astype(dtype), aux_loss=None)

@@ -1,0 +1,132 @@
+"""Native (C++) data-plane kernels with compile-on-first-use loading.
+
+The shared library is built from csrc/dataplane.cpp with g++ on first
+import and cached under AREAL_NATIVE_CACHE (default: alongside the source,
+keyed by a source hash, so editing the .cpp rebuilds).  Every binding has a
+pure-Python fallback — `available()` reports which path is active, and the
+parity tests assert both agree (tests/test_native.py).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "dataplane.cpp")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get("AREAL_NATIVE_CACHE") or os.path.join(
+        os.path.dirname(__file__), "_build"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            with open(_SRC, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(_build_dir(), f"dataplane-{tag}.so")
+            if not os.path.exists(so):
+                tmp = f"{so}.tmp.{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, so)  # atomic vs concurrent builders
+            lib = ctypes.CDLL(so)
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.ffd_assign.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i32p]
+            lib.ffd_assign.restype = ctypes.c_int64
+            lib.lpt_assign.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i32p]
+            lib.lpt_assign.restype = None
+            lib.slice_intervals.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u8p]
+            lib.slice_intervals.restype = None
+            lib.set_intervals.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u8p]
+            lib.set_intervals.restype = None
+            _LIB = lib
+            logger.info(f"native dataplane loaded ({so})")
+        except Exception as e:  # noqa: BLE001 — fall back to Python
+            logger.warning(f"native dataplane unavailable ({e}); using Python")
+            _LIB = None
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def ffd_assign(sizes: Sequence[int], capacity: int) -> Optional[np.ndarray]:
+    """bin_of[i] for first-fit-decreasing packing, or None when the native
+    library is unavailable (callers fall back to the Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    s = np.ascontiguousarray(sizes, dtype=np.int64)
+    out = np.empty(len(s), dtype=np.int32)
+    lib.ffd_assign(s, len(s), int(capacity), out)
+    return out
+
+
+def lpt_assign(sizes: Sequence[int], k: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    s = np.ascontiguousarray(sizes, dtype=np.int64)
+    out = np.empty(len(s), dtype=np.int32)
+    lib.lpt_assign(s, len(s), int(k), out)
+    return out
+
+
+def slice_intervals(
+    src: np.ndarray, offsets: Sequence[int], lens: Sequence[int]
+) -> Optional[np.ndarray]:
+    """Gather byte intervals of `src` (uint8 view) into one contiguous
+    array; None if native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src).view(np.uint8).reshape(-1)
+    off = np.ascontiguousarray(offsets, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    out = np.empty(int(ln.sum()), dtype=np.uint8)
+    lib.slice_intervals(src, off, ln, len(off), out)
+    return out
+
+
+def set_intervals(
+    dst: np.ndarray, offsets: Sequence[int], lens: Sequence[int], src: np.ndarray
+) -> bool:
+    """Scatter contiguous `src` bytes into intervals of `dst` in place;
+    False if native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    dstv = dst.view(np.uint8).reshape(-1)
+    off = np.ascontiguousarray(offsets, dtype=np.int64)
+    ln = np.ascontiguousarray(lens, dtype=np.int64)
+    srcv = np.ascontiguousarray(src).view(np.uint8).reshape(-1)
+    lib.set_intervals(dstv, off, ln, len(off), srcv)
+    return True

@@ -1,0 +1,100 @@
+// Native data-plane kernels for the host-side hot path.
+//
+// TPU-native counterpart of the reference's csrc/ extensions: the reference
+// ships CUDA interval-copy kernels (csrc/interval_op/interval_op.cu) for
+// gathering/scattering parameter fragments and does its micro-batch
+// bin-packing in Python (areal/utils/datapack.py ffd_allocate).  On TPU the
+// device-side work belongs to XLA; what remains hot on the HOST is
+//   (a) per-batch bin-packing (FFD / LPT) that runs in the rollout->train
+//       handoff for every batch, and
+//   (b) interval slice/set memcpy used when chunking parameter bytes for
+//       the transfer weight-sync path.
+// Compiled with g++ -O3 -shared -fPIC, loaded via ctypes
+// (areal_tpu/native/__init__.py); every entry point has a pure-Python
+// fallback with identical semantics (parity-tested).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// First-fit-decreasing bin packing.  Items sorted by decreasing size
+// (stable: ties keep index order) are placed into the first existing bin
+// with room, else a new bin.  Returns the bin count; bin_of[i] = bin of
+// item i.  Items larger than capacity get singleton bins (first-fit finds
+// no room, matching the Python reference semantics).
+int64_t ffd_assign(const int64_t* sizes, int64_t n, int64_t capacity,
+                   int32_t* bin_of) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return sizes[a] > sizes[b]; });
+  std::vector<int64_t> loads;
+  loads.reserve(64);
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t idx = order[k];
+    const int64_t size = sizes[idx];
+    int64_t placed = -1;
+    for (size_t b = 0; b < loads.size(); ++b) {
+      if (loads[b] + size <= capacity) {
+        placed = static_cast<int64_t>(b);
+        break;
+      }
+    }
+    if (placed < 0) {
+      placed = static_cast<int64_t>(loads.size());
+      loads.push_back(0);
+    }
+    loads[placed] += size;
+    bin_of[idx] = static_cast<int32_t>(placed);
+  }
+  return static_cast<int64_t>(loads.size());
+}
+
+// Longest-processing-time balanced partition into exactly k groups:
+// descending sizes, each item to the currently lightest group (ties ->
+// lowest group index, matching numpy argmin).
+void lpt_assign(const int64_t* sizes, int64_t n, int64_t k,
+                int32_t* group_of) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return sizes[a] > sizes[b]; });
+  std::vector<int64_t> loads(k, 0);
+  for (int64_t t = 0; t < n; ++t) {
+    const int64_t idx = order[t];
+    int64_t best = 0;
+    for (int64_t g = 1; g < k; ++g) {
+      if (loads[g] < loads[best]) best = g;
+    }
+    loads[best] += sizes[idx];
+    group_of[idx] = static_cast<int32_t>(best);
+  }
+}
+
+// Gather byte intervals [src + offsets[i], +lens[i]) into contiguous dst.
+// (reference: csrc/interval_op slice_intervals, host flavor)
+void slice_intervals(const uint8_t* src, const int64_t* offsets,
+                     const int64_t* lens, int64_t n, uint8_t* dst) {
+  int64_t out = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + out, src + offsets[i], static_cast<size_t>(lens[i]));
+    out += lens[i];
+  }
+}
+
+// Scatter contiguous src back into byte intervals of dst.
+// (reference: csrc/interval_op set_intervals, host flavor)
+void set_intervals(uint8_t* dst, const int64_t* offsets, const int64_t* lens,
+                   int64_t n, const uint8_t* src) {
+  int64_t in = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + offsets[i], src + in, static_cast<size_t>(lens[i]));
+    in += lens[i];
+  }
+}
+
+}  // extern "C"

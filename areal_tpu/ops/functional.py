@@ -73,12 +73,30 @@ def _chunk_len(n: int, target: int) -> int:
     return c
 
 
+def _clamped_entropy(logits: jax.Array, entropy_clamp: float) -> jax.Array:
+    """Entropy of the policy renormalised over the top (1-entropy_clamp)
+    fraction of the vocabulary — the bottom tail is masked out before the
+    softmax (reference: recipe/AEnt/functional.py clamped_softmax_entropy,
+    which removes the k = V*clamp smallest logits).  Token-space clamping
+    keeps the entropy bonus from rewarding mass on junk tokens."""
+    V = logits.shape[-1]
+    keep = max(1, V - int(V * entropy_clamp))
+    kth = jax.lax.top_k(logits, keep)[0][..., -1:]
+    mask = logits >= kth
+    neg_inf = jnp.finfo(logits.dtype).min
+    clamped = jnp.where(mask, logits, neg_inf)
+    logz = jax.nn.logsumexp(clamped, axis=-1)
+    p = jax.nn.softmax(clamped, axis=-1)
+    return logz - jnp.sum(jnp.where(mask, p * logits, 0.0), axis=-1)
+
+
 def lm_logprobs_entropy(
     out,  # LMOutput (deferred head) or materialised logits [..., V]
     labels: jax.Array,  # int [...]
     temperature: float = 1.0,
     chunk: int = 1024,
     with_entropy: bool = True,
+    entropy_clamp: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(logprobs, entropy, argmax-correct) of `labels`, fp32 numerics.
 
@@ -98,6 +116,8 @@ def lm_logprobs_entropy(
     if not isinstance(out, LMOutput):
         logits = out.astype(jnp.float32) * inv_t
         logp, ent = gather_logprobs_entropy(logits, labels)
+        if entropy_clamp > 0:
+            ent = _clamped_entropy(logits, entropy_clamp)
         corr = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
         return logp, ent, corr
 
@@ -118,8 +138,11 @@ def lm_logprobs_entropy(
         logz = jax.nn.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
         if with_entropy:
-            p = jax.nn.softmax(logits, axis=-1)
-            ent = logz - jnp.sum(p * logits, axis=-1)
+            if entropy_clamp > 0:
+                ent = _clamped_entropy(logits, entropy_clamp)
+            else:
+                p = jax.nn.softmax(logits, axis=-1)
+                ent = logz - jnp.sum(p * logits, axis=-1)
             corr = (jnp.argmax(logits, axis=-1) == lc).astype(jnp.float32)
         else:
             ent = jnp.zeros_like(logz)

@@ -1,0 +1,3 @@
+from areal_tpu.recipes.aent import AEntConfig, AEntPPOActorConfig, JaxAEntPPOActor
+
+__all__ = ["AEntConfig", "AEntPPOActorConfig", "JaxAEntPPOActor"]

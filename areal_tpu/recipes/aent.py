@@ -1,0 +1,155 @@
+"""AEnt: adaptive entropy-regularized GRPO.
+
+Behavioral counterpart of the reference's `recipe/AEnt` (actor.py,
+functional.py, aent_args.py): GRPO with a clamped-entropy bonus whose
+coefficient is adapted online to keep policy entropy inside a target band —
+
+    after each update:
+        coeff -= coeff_lr * (min(0, H - H_low) + max(0, H - H_high))
+        coeff  clamped to [box_low, box_high]        (actor.py:154-159)
+
+The entropy itself is *token-space clamped*: the bottom `entropy_clamp`
+fraction of the vocabulary is masked before the entropy is computed
+(functional.py clamped_softmax_entropy), so the bonus cannot be farmed by
+spreading mass over junk tokens.
+
+TPU-first detail: the live coefficient enters the jitted loss through the
+batch (a per-row array) instead of a Python closure — rebuilding the
+closure each step would recompile the fused train step on every
+coefficient change.
+"""
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.config import PPOActorConfig
+from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.engine.ppo.actor import PPOActor
+from areal_tpu.ops.functional import grpo_loss_fn, lm_logprobs_entropy, ppo_actor_loss_fn
+
+
+@dataclass
+class AEntConfig:
+    """reference: recipe/AEnt/aent_args.py"""
+
+    entropy_coeff: float = 1e-3
+    entropy_clamp: float = 0.0  # fraction of vocab masked from the entropy
+    adaptive: bool = True
+    entropy_low: float = 0.2
+    entropy_high: float = 0.4
+    coeff_lr: float = 1e-3
+    coeff_box_low: float = 0.0
+    coeff_box_high: float = 1e-2
+    warmup_steps: int = 0
+
+
+@dataclass
+class AEntPPOActorConfig(PPOActorConfig):
+    aent: AEntConfig = field(default_factory=AEntConfig)
+
+
+def aent_grpo_loss_fn(
+    model_out,
+    batch: Dict[str, jnp.ndarray],
+    eps_clip: float,
+    c_clip: Optional[float] = None,
+    behav_imp_weight_cap: Optional[float] = None,
+    temperature: float = 1.0,
+    use_decoupled_loss: bool = True,
+    eps_clip_higher: Optional[float] = None,
+    entropy_clamp: float = 0.0,
+):
+    """grpo_loss_fn with a clamped-entropy bonus scaled by the per-batch
+    `entropy_coeff` array (reference: recipe/AEnt/actor.py aent_grpo_loss_fn)."""
+    labels = jnp.roll(batch["input_ids"], -1, axis=-1)
+    loss_mask = batch["loss_mask"].astype(jnp.float32)
+    logprobs, entropy, _ = lm_logprobs_entropy(
+        model_out, labels, temperature=temperature, entropy_clamp=entropy_clamp
+    )
+    prox = batch.get("prox_logp") if use_decoupled_loss else None
+    loss, stats = ppo_actor_loss_fn(
+        logprobs=logprobs,
+        old_logprobs=batch["logprobs"],
+        advantages=batch["advantages"],
+        eps_clip=eps_clip,
+        loss_mask=loss_mask,
+        c_clip=c_clip,
+        proximal_logprobs=prox,
+        behav_imp_weight_cap=behav_imp_weight_cap,
+        eps_clip_higher=eps_clip_higher,
+    )
+    # live coefficient rides in the batch: max over loss tokens of a
+    # constant-filled array recovers the scalar without a fixed position
+    coeff = jnp.max(batch["entropy_coeff"] * loss_mask)
+    loss = loss - coeff * jnp.sum(entropy * loss_mask)
+    stats["entropy"] = jnp.sum(entropy * loss_mask)
+    stats["new_logp"] = jnp.sum(logprobs * loss_mask)
+    stats["old_logp"] = jnp.sum(batch["logprobs"] * loss_mask)
+    return loss, stats
+
+
+class AEntPPOActor(PPOActor):
+    LOSS_KEYS = PPOActor.LOSS_KEYS + ("entropy_coeff",)
+
+    def __init__(self, config: AEntPPOActorConfig, engine):
+        super().__init__(config, engine)
+        self.aent = config.aent
+        self.entropy_coeff = float(self.aent.entropy_coeff)
+        self._updates_done = 0
+        # override the parent's cached loss fn with the AEnt variant; the
+        # partial is built ONCE so the engine's train-step cache hits
+        self._loss_fn = functools.partial(
+            aent_grpo_loss_fn,
+            eps_clip=config.eps_clip,
+            c_clip=config.c_clip,
+            behav_imp_weight_cap=config.behav_imp_weight_cap,
+            temperature=config.temperature,
+            use_decoupled_loss=config.use_decoupled_loss,
+            eps_clip_higher=config.eps_clip_higher,
+            entropy_clamp=self.aent.entropy_clamp,
+        )
+
+    def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
+        shape = batch["input_ids"].shape
+        batch = dict(batch)
+        batch["entropy_coeff"] = np.full(shape, self.entropy_coeff, np.float32)
+        all_stats = super().ppo_update(batch)
+        if self.aent.adaptive:
+            self._updates_done += 1
+            if self._updates_done > self.aent.warmup_steps:
+                ent = float(np.mean([s["entropy"] for s in all_stats]))
+                self.entropy_coeff -= self.aent.coeff_lr * (
+                    min(0.0, ent - self.aent.entropy_low)
+                    + max(0.0, ent - self.aent.entropy_high)
+                )
+                self.entropy_coeff = float(
+                    np.clip(
+                        self.entropy_coeff,
+                        self.aent.coeff_box_low,
+                        self.aent.coeff_box_high,
+                    )
+                )
+        for s in all_stats:
+            s["entropy_coeff"] = self.entropy_coeff
+        return all_stats
+
+
+class JaxAEntPPOActor(JaxTrainEngine):
+    """JaxTrainEngine + AEnt actor (mirrors JaxPPOActor's wiring)."""
+
+    def __init__(self, config: AEntPPOActorConfig, model_config=None):
+        super().__init__(config, model_config)
+        self.actor = AEntPPOActor(config, self)
+
+    def compute_logp(self, batch):
+        return self.actor.compute_logp(batch)
+
+    def compute_advantages(self, batch):
+        self.actor.compute_advantages(batch)
+
+    def ppo_update(self, batch):
+        return self.actor.ppo_update(batch)

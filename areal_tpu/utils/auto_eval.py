@@ -1,0 +1,165 @@
+"""Automatic checkpoint evaluator.
+
+Behavioral counterpart of the legacy `AutomaticEvaluator`
+(realhf/scheduler/evaluator.py:348): a sidecar that watches the saver's
+checkpoint root, and for every new checkpoint spawns an evaluation job
+(a user-supplied command template), one at a time in save order, recording
+results so a restart never re-evaluates finished checkpoints.
+
+The in-loop `Evaluator` (utils/evaluator.py) covers frequency-gated online
+eval; this class covers the offline "evaluate every saved checkpoint on the
+benchmark suite" workflow, decoupled from the trainer's pace.
+"""
+
+import json
+import os
+import re
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("auto_eval")
+
+
+@dataclass
+class AutoEvalConfig:
+    ckpt_root: str = ""  # the Saver's save_root
+    # command template; {ckpt} and {name} are substituted per checkpoint
+    eval_cmd: str = ""
+    output_path: str = ""  # jsonl of results (default: <ckpt_root>/autoeval.jsonl)
+    poll_interval: float = 10.0
+    timeout: float = 3600.0
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+class AutomaticEvaluator:
+    def __init__(self, config: AutoEvalConfig):
+        if not config.ckpt_root or not config.eval_cmd:
+            raise ValueError("AutoEvalConfig needs ckpt_root and eval_cmd")
+        self.config = config
+        self.output_path = config.output_path or os.path.join(
+            config.ckpt_root, "autoeval.jsonl"
+        )
+        self._done = self._load_done()
+
+    # ------------------------------------------------------------------
+
+    def _load_done(self) -> set:
+        done = set()
+        if os.path.exists(self.output_path):
+            with open(self.output_path) as f:
+                for line in f:
+                    try:
+                        done.add(json.loads(line)["name"])
+                    except (json.JSONDecodeError, KeyError):
+                        continue
+        return done
+
+    @staticmethod
+    def _step_of(name: str) -> int:
+        """Sort key: trailing integer in the checkpoint dir name (the
+        Saver emits .../globalstep<N> style names); unknown -> mtime order
+        handled by the caller."""
+        m = re.search(r"(\d+)$", name)
+        return int(m.group(1)) if m else -1
+
+    def pending_checkpoints(self) -> List[str]:
+        root = self.config.ckpt_root
+        if not os.path.isdir(root):
+            return []
+        entries = []
+        for name in os.listdir(root):
+            path = os.path.join(root, name)
+            # a checkpoint is ready when its directory contains model files
+            # (the engines write staged-then-rename, so presence = complete)
+            if not os.path.isdir(path) or name in self._done:
+                continue
+            if not any(
+                f.endswith((".safetensors", ".zarr", "config.json"))
+                for f in os.listdir(path)
+            ):
+                continue
+            entries.append(name)
+        return sorted(entries, key=lambda n: (self._step_of(n), n))
+
+    def evaluate_one(self, name: str) -> Dict:
+        path = os.path.join(self.config.ckpt_root, name)
+        # plain replacement, not str.format: eval commands legitimately
+        # contain JSON/shell braces
+        cmd = self.config.eval_cmd.replace("{ckpt}", path).replace("{name}", name)
+        logger.info(f"evaluating {name}: {cmd}")
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                cmd,
+                shell=True,
+                capture_output=True,
+                text=True,
+                timeout=self.config.timeout,
+                env={**os.environ, **self.config.env},
+            )
+            # convention: the eval prints one JSON line (its metrics) last
+            metrics: Optional[dict] = None
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    metrics = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            result = {
+                "name": name,
+                "rc": proc.returncode,
+                "metrics": metrics,
+                "wall_s": round(time.time() - t0, 1),
+            }
+            if proc.returncode != 0:
+                result["stderr_tail"] = proc.stderr[-2000:]
+        except subprocess.TimeoutExpired:
+            result = {
+                "name": name,
+                "rc": -1,
+                "metrics": None,
+                "error": "timeout",
+                "wall_s": round(time.time() - t0, 1),
+            }
+        with open(self.output_path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+        self._done.add(name)
+        logger.info(f"eval {name} done: {result.get('metrics')}")
+        return result
+
+    def step(self) -> List[Dict]:
+        """Evaluate every currently-pending checkpoint (in save order)."""
+        return [self.evaluate_one(n) for n in self.pending_checkpoints()]
+
+    def run_forever(self, stop_check=None):
+        while stop_check is None or not stop_check():
+            self.step()
+            time.sleep(self.config.poll_interval)
+
+
+def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-root", required=True)
+    p.add_argument("--eval-cmd", required=True,
+                   help="shell template; {ckpt}/{name} substituted")
+    p.add_argument("--poll-interval", type=float, default=10.0)
+    p.add_argument("--timeout", type=float, default=3600.0)
+    args = p.parse_args()
+    AutomaticEvaluator(
+        AutoEvalConfig(
+            ckpt_root=args.ckpt_root,
+            eval_cmd=args.eval_cmd,
+            poll_interval=args.poll_interval,
+            timeout=args.timeout,
+        )
+    ).run_forever()
+
+
+if __name__ == "__main__":
+    main()

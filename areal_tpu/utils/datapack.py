@@ -1,12 +1,17 @@
 """Sequence bin-packing for balanced micro-batches.
 
 Capability counterpart of the reference's `areal/utils/datapack.py` (FFD
-allocation used by `allocate_balanced_mbs`).  Numpy-only.
+allocation used by `allocate_balanced_mbs`).  The packing runs per batch in
+the rollout->train handoff, so the assignment loops dispatch to the native
+C++ dataplane (areal_tpu/native) when it is available; the numpy paths
+below are the semantics reference and the fallback.
 """
 
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from areal_tpu import native
 
 
 def ffd_allocate(
@@ -27,18 +32,28 @@ def ffd_allocate(
     order = np.argsort(-sizes, kind="stable")
     bins: List[List[int]] = []
     loads: List[int] = []
-    for idx in order:
-        size = int(sizes[idx])
-        placed = False
-        for b in range(len(bins)):
-            if loads[b] + size <= capacity:
-                bins[b].append(int(idx))
-                loads[b] += size
-                placed = True
-                break
-        if not placed:
-            bins.append([int(idx)])
-            loads.append(size)
+    bin_of = native.ffd_assign(sizes, capacity)
+    if bin_of is not None:
+        n_bins = int(bin_of.max()) + 1 if len(bin_of) else 0
+        bins = [[] for _ in range(n_bins)]
+        loads = [0] * n_bins
+        for idx in order:  # same placement order as the Python loop
+            b = int(bin_of[idx])
+            bins[b].append(int(idx))
+            loads[b] += int(sizes[idx])
+    else:
+        for idx in order:
+            size = int(sizes[idx])
+            placed = False
+            for b in range(len(bins)):
+                if loads[b] + size <= capacity:
+                    bins[b].append(int(idx))
+                    loads[b] += size
+                    placed = True
+                    break
+            if not placed:
+                bins.append([int(idx)])
+                loads.append(size)
     while len(bins) < min_groups:
         # steal the last item of the heaviest multi-item bin
         donor = max(
@@ -59,6 +74,11 @@ def balanced_partition(sizes: Sequence[int], k: int) -> List[List[int]]:
     if k <= 0:
         raise ValueError("k must be positive")
     groups: List[List[int]] = [[] for _ in range(k)]
+    group_of = native.lpt_assign(sizes, k)
+    if group_of is not None:
+        for idx in np.argsort(-sizes, kind="stable"):
+            groups[int(group_of[idx])].append(int(idx))
+        return groups
     loads = np.zeros(k, dtype=np.int64)
     for idx in np.argsort(-sizes, kind="stable"):
         b = int(np.argmin(loads))

@@ -1,0 +1,132 @@
+"""AEnt recipe: clamped entropy + adaptive entropy-coefficient GRPO
+(reference: recipe/AEnt)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    MeshConfig,
+    MicroBatchSpec,
+    NormConfig,
+    OptimizerConfig,
+)
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.ops.functional import _clamped_entropy
+from areal_tpu.recipes import AEntConfig, AEntPPOActorConfig, JaxAEntPPOActor
+
+MODEL_CFG = tiny_config(vocab_size=64, qkv_bias=True, hf_architecture="Qwen2ForCausalLM")
+
+
+def test_clamped_entropy_math():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(5, 32)).astype(np.float32))
+    full = _clamped_entropy(logits, 0.0)
+    p = np.exp(np.asarray(logits)) / np.exp(np.asarray(logits)).sum(-1, keepdims=True)
+    expect = -(p * np.log(p)).sum(-1)
+    np.testing.assert_allclose(np.asarray(full), expect, rtol=1e-5)
+
+    # clamping reduces entropy (mass renormalised over fewer tokens)
+    clamped = _clamped_entropy(logits, 0.5)
+    assert np.all(np.asarray(clamped) <= np.asarray(full) + 1e-6)
+
+    # extreme clamp -> near-deterministic over the single kept token
+    extreme = _clamped_entropy(logits, 1.0 - 1.0 / 32)
+    assert np.all(np.asarray(extreme) < 0.7)
+
+
+def _actor(aent: AEntConfig, group_size=4):
+    cfg = AEntPPOActorConfig(
+        experiment_name="aent",
+        trial_name="t",
+        init_from_scratch=True,
+        dtype="float32",
+        gradient_checkpointing=False,
+        mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+        optimizer=OptimizerConfig(
+            lr=5e-3, warmup_steps_proportion=0.0, weight_decay=0.0
+        ),
+        pack_length_quantum=16,
+        group_size=group_size,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(
+            mean_level="group", std_level="group", group_size=group_size
+        ),
+        aent=aent,
+    )
+    actor = JaxAEntPPOActor(cfg, model_config=MODEL_CFG)
+    actor.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    return actor
+
+
+def _batch(rng, B=8, L=16, prompt_len=4):
+    ids = rng.integers(0, MODEL_CFG.vocab_size, (B, L)).astype(np.int32)
+    loss_mask = np.zeros((B, L), np.float32)
+    loss_mask[:, prompt_len:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": np.ones((B, L), bool),
+        "loss_mask": loss_mask,
+        "logprobs": rng.normal(-1.0, 0.1, (B, L)).astype(np.float32) * loss_mask,
+        "rewards": (ids[:, prompt_len] % 2 == 0).astype(np.float32),
+        "versions": np.zeros((B, L), np.int32),
+    }
+
+
+def test_aent_update_and_adaptive_coeff():
+    aent = AEntConfig(
+        entropy_coeff=5e-3,
+        entropy_clamp=0.25,
+        adaptive=True,
+        entropy_low=100.0,  # force H < low -> coeff must INCREASE
+        entropy_high=200.0,
+        coeff_lr=1e-3,
+        coeff_box_high=1.0,
+        warmup_steps=0,
+    )
+    actor = _actor(aent)
+    try:
+        rng = np.random.default_rng(1)
+        batch = _batch(rng)
+        batch["prox_logp"] = actor.compute_logp(batch)
+        actor.compute_advantages(batch)
+
+        c0 = actor.actor.entropy_coeff
+        stats = actor.ppo_update(batch)
+        assert np.isfinite(stats[-1]["loss"])
+        assert stats[-1]["entropy"] > 0
+        # entropy (a few nats) << entropy_low=100 -> coeff rises
+        assert actor.actor.entropy_coeff > c0
+        assert stats[-1]["entropy_coeff"] == actor.actor.entropy_coeff
+
+        # coefficient stays inside the box under repeated updates
+        for _ in range(2):
+            actor.compute_advantages(batch)
+            actor.ppo_update(batch)
+        assert aent.coeff_box_low <= actor.actor.entropy_coeff <= aent.coeff_box_high
+    finally:
+        actor.destroy()
+
+
+def test_aent_coeff_decreases_above_band():
+    aent = AEntConfig(
+        entropy_coeff=5e-3,
+        adaptive=True,
+        entropy_low=0.0,
+        entropy_high=1e-6,  # force H > high -> coeff must DECREASE
+        coeff_lr=1e-4,
+        warmup_steps=0,
+    )
+    actor = _actor(aent)
+    try:
+        rng = np.random.default_rng(2)
+        batch = _batch(rng)
+        batch["prox_logp"] = actor.compute_logp(batch)
+        actor.compute_advantages(batch)
+        c0 = actor.actor.entropy_coeff
+        actor.ppo_update(batch)
+        assert actor.actor.entropy_coeff < c0
+    finally:
+        actor.destroy()

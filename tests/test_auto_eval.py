@@ -1,0 +1,77 @@
+"""AutomaticEvaluator tests (reference: realhf/scheduler/evaluator.py:348
+— watch ckpt dir, evaluate each new checkpoint once, persist results)."""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.utils.auto_eval import AutoEvalConfig, AutomaticEvaluator
+
+
+def _make_ckpt(root, name):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "config.json"), "w") as f:
+        f.write("{}")
+    return d
+
+
+def test_evaluates_new_checkpoints_in_order(tmp_path):
+    root = str(tmp_path / "ckpts")
+    _make_ckpt(root, "globalstep10")
+    _make_ckpt(root, "globalstep2")
+    os.makedirs(os.path.join(root, "not_a_ckpt"))  # no model files: skipped
+
+    log = tmp_path / "evals.txt"
+    ev = AutomaticEvaluator(
+        AutoEvalConfig(
+            ckpt_root=root,
+            eval_cmd=(
+                f"echo {{name}} >> {log} && "
+                "echo '{\"accuracy\": 0.5, \"ckpt\": \"{name}\"}'"
+            ),
+        )
+    )
+    results = ev.step()
+    assert [r["name"] for r in results] == ["globalstep2", "globalstep10"]
+    assert all(r["rc"] == 0 for r in results)
+    assert results[0]["metrics"]["accuracy"] == 0.5
+    assert log.read_text().split() == ["globalstep2", "globalstep10"]
+
+    # second sweep: nothing new -> no re-evaluation
+    assert ev.step() == []
+
+    # new checkpoint appears -> only it runs
+    _make_ckpt(root, "globalstep20")
+    results = ev.step()
+    assert [r["name"] for r in results] == ["globalstep20"]
+
+
+def test_results_persist_across_restart(tmp_path):
+    root = str(tmp_path / "ckpts")
+    _make_ckpt(root, "globalstep1")
+    cfg = AutoEvalConfig(ckpt_root=root, eval_cmd="echo '{\"ok\": 1}'")
+    AutomaticEvaluator(cfg).step()
+
+    # a fresh instance (restart) reads the jsonl and skips finished work
+    ev2 = AutomaticEvaluator(cfg)
+    assert ev2.step() == []
+    lines = open(os.path.join(root, "autoeval.jsonl")).read().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["metrics"] == {"ok": 1}
+
+
+def test_failed_eval_recorded_with_stderr(tmp_path):
+    root = str(tmp_path / "ckpts")
+    _make_ckpt(root, "globalstep1")
+    ev = AutomaticEvaluator(
+        AutoEvalConfig(ckpt_root=root, eval_cmd="echo doom >&2; exit 3")
+    )
+    (r,) = ev.step()
+    assert r["rc"] == 3 and "doom" in r["stderr_tail"]
+    assert r["metrics"] is None
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutomaticEvaluator(AutoEvalConfig())

@@ -1,0 +1,104 @@
+"""Sandboxed code-reward tests (reference: functioncall/code local verify +
+realhf math/code reward interfaces)."""
+
+import time
+
+import pytest
+
+from areal_tpu.reward.code_verifier import (
+    CaseResult,
+    code_reward_fn,
+    extract_code,
+    verify_code,
+)
+
+
+def test_extract_code_prefers_last_fence():
+    text = (
+        "First try:\n```python\nprint(1)\n```\n"
+        "Actually:\n```python\nprint(2)\n```\n"
+    )
+    assert extract_code(text) == "print(2)"
+    assert extract_code("print(3)") == "print(3)"
+
+
+def test_stdio_pass_and_fail():
+    gen = "```python\nx = int(input())\nprint(x * 2)\n```"
+    problem = {"inputs": ["3\n", "10\n"], "outputs": ["6\n", "20\n"]}
+    results = verify_code(gen, problem)
+    assert all(r.passed for r in results)
+
+    bad = "```python\nx = int(input())\nprint(x + 1)\n```"
+    results = verify_code(bad, problem)
+    assert not any(r.passed for r in results)
+    assert "wrong answer" in results[0].reason
+
+
+def test_numeric_tolerance():
+    gen = "```python\nprint(1/3)\n```"
+    problem = {"inputs": [""], "outputs": ["0.3333333333\n"]}
+    # 0.3333333333333333 vs 0.3333333333 within 1e-6 relative
+    assert verify_code(gen, problem)[0].passed
+
+
+def test_assert_style():
+    gen = "```python\ndef f(x):\n    return x * x\n```"
+    ok = verify_code(gen, {"asserts": ["assert f(3) == 9"]})
+    assert ok[0].passed
+    bad = verify_code(gen, {"asserts": ["assert f(3) == 10"]})
+    assert not bad[0].passed
+
+
+def test_crash_and_timeout_and_memory():
+    crash = verify_code("raise RuntimeError('boom')", {"inputs": [""], "outputs": [""]})
+    assert not crash[0].passed and "exit" in crash[0].reason
+
+    t0 = time.monotonic()
+    loop = verify_code(
+        "while True:\n    pass", {"inputs": [""], "outputs": [""]}, timeout=1.5
+    )
+    assert not loop[0].passed and loop[0].reason == "timeout"
+    assert time.monotonic() - t0 < 10
+
+    bomb = verify_code(
+        "x = bytearray(10**10)\nprint('survived')",
+        {"inputs": [""], "outputs": ["survived\n"]},
+        timeout=5.0,
+        memory_mb=128,
+    )
+    assert not bomb[0].passed  # allocation refused by RLIMIT_AS
+
+
+def test_sandbox_env_is_bare():
+    # generated code cannot see the parent's environment variables
+    import os
+
+    os.environ["AREAL_SECRET_PROBE"] = "leak"
+    try:
+        res = verify_code(
+            "import os\nprint(os.environ.get('AREAL_SECRET_PROBE', 'clean'))",
+            {"inputs": [""], "outputs": ["clean\n"]},
+        )
+        assert res[0].passed
+    finally:
+        del os.environ["AREAL_SECRET_PROBE"]
+
+
+def test_reward_fn_surface():
+    problem = {"inputs": ["2\n"], "outputs": ["4\n"]}
+    good = code_reward_fn(
+        "p", "```python\nprint(int(input())**2)\n```", [], [], problem=problem
+    )
+    bad = code_reward_fn("p", "```python\nprint(5)\n```", [], [], problem=problem)
+    assert (good, bad) == (1.0, 0.0)
+
+    import json
+
+    as_str = code_reward_fn(
+        "p", "```python\nprint(int(input())**2)\n```", [], [],
+        problem=json.dumps(problem),
+    )
+    assert as_str == 1.0
+
+    with pytest.raises(ValueError):
+        code_reward_fn("p", "x", [], [])

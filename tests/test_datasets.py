@@ -1,0 +1,95 @@
+"""Dataset loader tests (reference: areal/dataset/ — gsm8k/clevr covered
+elsewhere; here hhrlhf preference pairs, geometry3k vision manifests, and
+torl math rows + the registry dispatch)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from areal_tpu.dataset import get_custom_dataset
+from tests.fixtures import make_tiny_tokenizer
+
+
+@pytest.fixture(scope="module")
+def tok(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tok")
+    return make_tiny_tokenizer(str(d))
+
+
+def test_hhrlhf_pairs(tok, tmp_path):
+    rows = [
+        {"chosen": "good answer number one", "rejected": "bad"},
+        {"chosen": "ok", "rejected": "a much longer rejected response " * 10},
+    ]
+    p = tmp_path / "pairs.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    ds = get_custom_dataset(str(p), type="hhrlhf", tokenizer=tok)
+    assert len(ds) == 2
+    assert all(len(x["chosen_ids"]) > 0 and len(x["rejected_ids"]) > 0 for x in ds)
+
+    # max_length filters out the row with the long rejected side
+    n_tok_row0 = max(len(ds[0]["chosen_ids"]), len(ds[0]["rejected_ids"]))
+    short = get_custom_dataset(
+        str(p), type="hhrlhf", tokenizer=tok, max_length=n_tok_row0
+    )
+    assert len(short) == 1
+
+
+def test_geometry3k_manifest(tmp_path):
+    img = tmp_path / "diagram.png"
+    try:
+        from PIL import Image
+
+        Image.new("RGB", (40, 20), (255, 0, 0)).save(img)
+    except ImportError:
+        pytest.skip("PIL unavailable")
+    manifest = tmp_path / "train.jsonl"
+    manifest.write_text(
+        json.dumps(
+            {"image": "diagram.png", "problem": "find angle x", "answer": "42"}
+        )
+    )
+    ds = get_custom_dataset(str(tmp_path), type="geometry3k", split="train")
+    assert len(ds) == 1
+    sample = ds[0]
+    assert os.path.isabs(sample["images"][0])
+    assert sample["answer"] == "42"
+    assert sample["messages"] == "find angle x"
+
+    from areal_tpu.dataset.geometry3k import pad_to_square
+
+    from PIL import Image
+
+    sq = pad_to_square(Image.open(img))
+    assert sq.size == (40, 40)
+
+
+def test_torl_rows(tok, tmp_path):
+    rows = [
+        {
+            "prompt": [{"role": "user", "content": "compute 2+2"}],
+            "reward_model": {"ground_truth": "4"},
+            "data_source": "torl",
+            "ability": "math",
+            "extra_info": {},
+        }
+    ]
+    p = tmp_path / "torl.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    ds = get_custom_dataset(str(p), type="torl")
+    assert len(ds) == 1
+    assert ds[0]["answer"] == "\\boxed{4}"
+    assert ds[0]["messages"][0]["content"] == "compute 2+2"
+
+    # pre-converted shape works too
+    p2 = tmp_path / "conv.jsonl"
+    p2.write_text(json.dumps({"messages": "solve it", "answer": "7"}))
+    ds2 = get_custom_dataset(str(p2), type="torl")
+    assert ds2[0]["answer"] == "\\boxed{7}"
+
+
+def test_registry_dispatch_unknown():
+    with pytest.raises(ValueError, match="unknown dataset"):
+        get_custom_dataset("nope", type="definitely-not-registered")

@@ -1,0 +1,47 @@
+"""Preset/auto-allocation tests (reference: experiments/common auto
+device-mesh heuristics)."""
+
+import pytest
+
+from areal_tpu.api.alloc import AllocationMode
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.api.presets import auto_allocation, list_presets, preset
+
+
+def test_auto_allocation_small_model_many_chips():
+    # 1.5B on 8 v5e chips: tp=1 suffices for serving; training needs
+    # 1.5e9*10B ~ 15G > 14G -> tp=2
+    expr = auto_allocation(8, 1.5e9, device_kind="TPU v5 lite")
+    mode = AllocationMode.from_str(expr)
+    assert mode.gen is not None and mode.train is not None
+    assert mode.gen_world_size + mode.train_world_size <= 8
+    assert mode.train_world_size >= 2
+
+
+def test_auto_allocation_7b():
+    expr = auto_allocation(32, 7.6e9, device_kind="TPU v5 lite")
+    mode = AllocationMode.from_str(expr)
+    # serving a 7B needs 7.6e9*3B ~ 23G -> tp=2 on 14G chips; train tp >= 8
+    assert mode.gen_instance_size >= 2
+    assert mode.train.tensor_parallel_size >= 4
+    assert mode.gen_world_size + mode.train_world_size <= 32
+
+
+def test_auto_allocation_infeasible():
+    with pytest.raises(ValueError):
+        auto_allocation(2, 70e9, device_kind="TPU v5 lite")
+    with pytest.raises(ValueError):
+        auto_allocation(1, 1e9)
+
+
+def test_presets_are_loadable_configs(tmp_path):
+    import yaml
+
+    for name in list_presets():
+        d = preset(name)
+        assert AllocationMode.from_str(d["allocation_mode"])
+        cfg_path = tmp_path / f"{name}.yaml"
+        cfg_path.write_text(yaml.safe_dump(d))
+        cfg, _ = load_expr_config(["--config", str(cfg_path)], GRPOConfig)
+        assert cfg.actor.use_decoupled_loss
+        assert cfg.train_dataset.batch_size > 0

@@ -1,0 +1,168 @@
+"""Slurm launcher tests: sbatch rendering + submit/babysit/cancel against
+stub slurm binaries (no Slurm in this environment — same approach as the
+reference's sbatch-generation tests)."""
+
+import os
+import stat
+import textwrap
+
+import pytest
+
+from areal_tpu.launcher.slurm import (
+    SlurmJobSpec,
+    SlurmLauncher,
+    render_sbatch,
+)
+
+
+def test_render_sbatch_contents(tmp_path):
+    spec = SlurmJobSpec(
+        job_name="exp-train",
+        cmd="python entry.py --config c.yaml",
+        n_tasks=4,
+        cpus_per_task=8,
+        mem_per_task_mb=65536,
+        gres="tpu:4",
+        partition="tpu-pod",
+        time_limit="12:00:00",
+        env={"AREAL_NUM_PROCESSES": "4", "AREAL_NAME_RESOLVE": "nfs:/shared/nr"},
+        log_path="/logs/train_%j.log",
+    )
+    script = render_sbatch(spec)
+    for expected in [
+        "#SBATCH --job-name=exp-train",
+        "#SBATCH --ntasks=4",
+        "#SBATCH --gres=tpu:4",
+        "#SBATCH --partition=tpu-pod",
+        "#SBATCH --time=12:00:00",
+        "#SBATCH --mem-per-cpu=8192M",
+        "export AREAL_NUM_PROCESSES=4",
+        "export AREAL_PROCESS_ID=$SLURM_PROCID",
+        "srun --kill-on-bad-exit=1",
+    ]:
+        assert expected in script, f"missing {expected!r}\n{script}"
+    # container wrapping
+    spec.container = "/images/areal.sif"
+    assert "apptainer exec" in render_sbatch(spec)
+
+
+@pytest.fixture()
+def stub_slurm(tmp_path):
+    """Fake sbatch/squeue/scancel: sbatch records the script and prints an
+    id; squeue reads a state file the test controls; scancel records."""
+    state = tmp_path / "state"
+    state.write_text("RUNNING")
+    sbatch = tmp_path / "sbatch"
+    sbatch.write_text(
+        textwrap.dedent(
+            f"""\
+            #!/bin/bash
+            echo "$@" >> {tmp_path}/sbatch.calls
+            cp "${{@: -1}}" {tmp_path}/submitted_$(basename "${{@: -1}}")
+            echo "$((1000 + $(wc -l < {tmp_path}/sbatch.calls)))"
+            """
+        )
+    )
+    squeue = tmp_path / "squeue"
+    squeue.write_text(
+        textwrap.dedent(
+            f"""\
+            #!/bin/bash
+            cat {state}
+            """
+        )
+    )
+    scancel = tmp_path / "scancel"
+    scancel.write_text(
+        f"#!/bin/bash\necho \"$@\" >> {tmp_path}/scancel.calls\n"
+    )
+    for p in (sbatch, squeue, scancel):
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return tmp_path, state
+
+
+def _write_cfg(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            f"""\
+            experiment_name: slurmtest
+            trial_name: t0
+            cluster:
+              fileroot: {tmp_path}/runs
+              name_resolve:
+                type: nfs
+                nfs_record_root: {tmp_path}/nr
+            """
+        )
+    )
+    return str(cfg)
+
+
+def test_submit_babysit_cancel(stub_slurm, tmp_path):
+    stub_dir, state = stub_slurm
+    launcher = SlurmLauncher(
+        "entry.py",
+        ["--config", _write_cfg(tmp_path)],
+        n_gen_servers=2,
+        n_train_procs=4,
+        sbatch_bin=str(stub_dir / "sbatch"),
+        squeue_bin=str(stub_dir / "squeue"),
+        scancel_bin=str(stub_dir / "scancel"),
+    )
+    gen_id = launcher.submit(launcher.gen_server_spec())
+    train_id = launcher.submit(launcher.trainer_spec())
+    assert gen_id != train_id
+
+    # both scripts hit sbatch and contain the wiring
+    submitted = [f for f in os.listdir(stub_dir) if f.startswith("submitted_")]
+    assert len(submitted) == 2
+    train_script = (stub_dir / "submitted_slurmtest-train.sbatch").read_text()
+    assert "AREAL_NUM_PROCESSES=4" in train_script
+    assert "AREAL_COORDINATOR=" in train_script
+    assert "AREAL_NAME_RESOLVE=" in train_script
+    gen_script = (stub_dir / "submitted_slurmtest-gen.sbatch").read_text()
+    assert "--server-idx $SLURM_PROCID" in gen_script
+    assert "#SBATCH --ntasks=2" in gen_script
+
+    assert launcher.job_state(train_id) == "RUNNING"
+    state.write_text("COMPLETED")
+    assert launcher.job_state(train_id) == "COMPLETED"
+
+    launcher.cancel_all()
+    calls = (stub_dir / "scancel.calls").read_text().splitlines()
+    assert sorted(calls) == sorted([gen_id, train_id])
+
+
+def test_run_returns_on_completion(stub_slurm, tmp_path):
+    stub_dir, state = stub_slurm
+    state.write_text("COMPLETED")
+    launcher = SlurmLauncher(
+        "entry.py",
+        ["--config", _write_cfg(tmp_path)],
+        n_gen_servers=0,
+        n_train_procs=1,
+        sbatch_bin=str(stub_dir / "sbatch"),
+        squeue_bin=str(stub_dir / "squeue"),
+        scancel_bin=str(stub_dir / "scancel"),
+    )
+    assert launcher.run(poll_interval=0.01) == 0
+
+    state.write_text("FAILED")
+    launcher2 = SlurmLauncher(
+        "entry.py",
+        ["--config", _write_cfg(tmp_path)],
+        n_gen_servers=0,
+        n_train_procs=1,
+        sbatch_bin=str(stub_dir / "sbatch"),
+        squeue_bin=str(stub_dir / "squeue"),
+        scancel_bin=str(stub_dir / "scancel"),
+    )
+    assert launcher2.run(poll_interval=0.01) == 1
+
+
+def test_requires_nfs_name_resolve(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("experiment_name: x\ntrial_name: y\n")
+    with pytest.raises(ValueError, match="nfs"):
+        SlurmLauncher("entry.py", ["--config", str(cfg)], 1, 1)
