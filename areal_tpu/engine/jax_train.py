@@ -317,19 +317,27 @@ class JaxTrainEngine(TrainEngine):
     # train / eval / forward
     # ------------------------------------------------------------------
 
+    def _call_model(self, params, batch):
+        """Model forward over one (micro-)batch dict.  The single seam the
+        jitted step/eval/forward programs call; modality subclasses (VLM)
+        override it to consume extra batch keys (pixels, mrope)."""
+        return self._model_fn(
+            params,
+            self.model_config,
+            batch["input_ids"],
+            batch["positions"],
+            batch["segment_ids"],
+            mesh=self.mesh,
+        )
+
     def _build_train_step(self, loss_fn: Callable):
-        mcfg = self.model_config
         optimizer = self._optimizer
         schedule = self._schedule
-        mesh = self.mesh
-        model_fn = self._model_fn
+        call_model = self._call_model
 
         def train_step(params, opt_state, batch, total_weight, step_idx):
             def mb_loss(p, mb):
-                logits = model_fn(
-                    p, mcfg, mb["input_ids"], mb["positions"], mb["segment_ids"],
-                    mesh=mesh,
-                )
+                logits = call_model(p, mb)
                 loss, stats = loss_fn(logits, mb)
                 return loss / total_weight, stats
 
@@ -453,24 +461,15 @@ class JaxTrainEngine(TrainEngine):
         total_weight = float(loss_weight_fn(data))
         stacked = self._stack_mbs(data, n_mbs)
         dev_batch = self._device_batch(stacked, stacked=True)
-        mcfg = self.model_config
 
         key = ("eval", loss_fn, n_mbs, row_len, stacked["input_ids"].shape[1])
         if key not in self._forward_cache:
 
-            model_fn = self._model_fn
-            mesh = self.mesh
+            call_model = self._call_model
 
             def eval_step(params, batch):
                 def mb_loss(carry, mb):
-                    logits = model_fn(
-                        params,
-                        mcfg,
-                        mb["input_ids"],
-                        mb["positions"],
-                        mb["segment_ids"],
-                        mesh=mesh,
-                    )
+                    logits = call_model(params, mb)
                     loss, stats = loss_fn(logits, mb)
                     return carry + loss, stats
 
@@ -510,25 +509,16 @@ class JaxTrainEngine(TrainEngine):
             )
         rp, data, row_len = self._prepare_rows(input_, 1)
         dev_batch = self._device_batch(data, stacked=False)
-        mcfg = self.model_config
 
         if post_hook is None:
             post_hook = _logp_hook
         key = ("fwd", post_hook, row_len, data["input_ids"].shape[0])
         if key not in self._forward_cache:
 
-            model_fn = self._model_fn
-            mesh = self.mesh
+            call_model = self._call_model
 
             def fwd_step(params, batch):
-                logits = model_fn(
-                    params,
-                    mcfg,
-                    batch["input_ids"],
-                    batch["positions"],
-                    batch["segment_ids"],
-                    mesh=mesh,
-                )
+                logits = call_model(params, batch)
                 return post_hook(logits, batch)
 
             # multi-process: output rows are sharded across hosts — jit

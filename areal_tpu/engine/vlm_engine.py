@@ -1,0 +1,261 @@
+"""JaxVLMEngine: vision-language training on the standard train engine.
+
+Capability counterpart of the reference's VLM train path (lite loads
+AutoModelForImageTextToText in BaseHFEngine and threads qwen2-VL mrope
+position ids through packing, base_hf_engine.py:261-287).  TPU-first shape:
+
+- the text stack, optimizer, sharding, checkpointing, and loss protocol are
+  inherited unchanged from JaxTrainEngine; only `_call_model` changes — it
+  runs the vision tower and scatters image embeddings before the decoder
+  (models/vision.py forward_vlm_lm);
+- batches stay PADDED (one sequence per row, original order) instead of
+  FFD row-packed: image patches are matched to placeholder tokens by scan
+  order, and repacking would permute sequences out from under their
+  pixels.  Filler rows/patches pad the shapes up to shard divisibility, so
+  everything remains static under jit.
+
+Batch keys beyond the text ones:
+  pixel_values     [N, patch_dim]  pre-patchified pixels, images in
+                                   sequence order (AutoProcessor layout)
+  patch_img_ids    [N]             image index per patch, -1 = padding
+  mrope_positions  [B, L, 3]       optional per-token (t, h, w) positions
+                                   (models/vision.py mrope_position_ids)
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.config import TrainEngineConfig
+from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.models.vision import forward_vlm_lm, init_vision_params
+from areal_tpu.utils.data import RowPackedBatch
+
+VISION_KEYS = ("pixel_values", "patch_img_ids")
+
+
+class JaxVLMEngine(JaxTrainEngine):
+    def __init__(
+        self,
+        config: TrainEngineConfig,
+        model_config: Optional[TransformerConfig] = None,
+    ):
+        if model_config is None or model_config.vision is None:
+            raise ValueError("JaxVLMEngine needs a model_config with .vision")
+        if model_config.image_token_id is None:
+            raise ValueError("model_config.image_token_id is required")
+        super().__init__(config, model_config)
+        if max(1, config.mb_spec.n_mbs) != 1:
+            raise NotImplementedError(
+                "VLM engine v1 runs a single micro-batch per step (pixel "
+                "tensors cannot be split across an mb scan); raise "
+                "batch-level parallelism instead"
+            )
+
+    # ------------------------------------------------------------------
+
+    def initialize(self, addr=None, ft_spec=None) -> None:
+        super().initialize(addr=addr, ft_spec=ft_spec)
+        if self.mesh.shape["sp"] != 1:
+            raise NotImplementedError("VLM engine v1 requires sp=1")
+        if "vision" not in self.params:
+            # scratch init of the tower when the checkpoint is text-only
+            import jax
+
+            from areal_tpu.parallel import shard_pytree
+
+            host = init_vision_params(
+                self.model_config.vision,
+                jax.random.PRNGKey(7),
+                dtype=jnp.dtype(self.config.param_dtype),
+            )
+            # vision tower is small: replicate it across the mesh
+            from jax.sharding import PartitionSpec as P
+
+            specs = jax.tree_util.tree_map(lambda _: P(), host)
+            self.params = dict(self.params)
+            self.params["vision"] = shard_pytree(self.mesh, host, specs)
+            # optimizer state was initialised from the text-only tree in
+            # super().initialize(); rebuild so moments cover the tower
+            if self._optimizer is not None:
+                self._build_optimizer(ft_spec)
+
+    # ------------------------------------------------------------------
+
+    def _prepare_rows(
+        self, batch: Dict[str, np.ndarray], n_mbs: int
+    ) -> Tuple[RowPackedBatch, Dict[str, np.ndarray], int]:
+        """Identity row-ification: sequence i -> row i (order preserved so
+        patch order matches placeholder order), padded with filler rows and
+        filler patches to shard divisibility."""
+        mask = batch["attention_mask"].astype(bool)
+        B, L = mask.shape
+        mult = n_mbs * (
+            self.mesh.shape["dp"]
+            * self.mesh.shape["fsdp"]
+            * self.mesh.shape.get("ep", 1)
+        )
+        R = ((B + mult - 1) // mult) * mult
+
+        data: Dict[str, np.ndarray] = {}
+        for k, v in batch.items():
+            if k in VISION_KEYS or k == "attention_mask":
+                continue
+            if v.ndim >= 2 and v.shape[:2] == (B, L):
+                buf = np.zeros((R, *v.shape[1:]), dtype=v.dtype)
+                buf[:B] = v
+                data[k] = buf
+        seg = np.where(mask, 0, -1).astype(np.int32)
+        data["segment_ids"] = np.full((R, L), -1, np.int32)
+        data["segment_ids"][:B] = seg
+        pos = np.maximum(mask.cumsum(-1) - 1, 0).astype(np.int32)
+        data["positions"] = np.zeros((R, L), np.int32)
+        data["positions"][:B] = pos
+        data["input_ids"] = data["input_ids"].astype(np.int32)
+        if "loss_mask" in data:
+            data["loss_mask"] = data["loss_mask"] * (data["segment_ids"] >= 0)
+
+        # vision: pad the patch dim to shard divisibility with -1-id patches
+        # (their merged embeddings land past every real placeholder index)
+        pv = batch["pixel_values"]
+        ids = batch["patch_img_ids"]
+        m2 = self.model_config.vision.spatial_merge_size ** 2
+        quantum = mult * m2
+        N = ((pv.shape[0] + quantum - 1) // quantum) * quantum
+        pad_pv = np.zeros((N, pv.shape[1]), pv.dtype)
+        pad_pv[: pv.shape[0]] = pv
+        pad_ids = np.full((N,), -1, np.int32)
+        pad_ids[: ids.shape[0]] = ids
+        data["pixel_values"] = pad_pv
+        data["patch_img_ids"] = pad_ids
+
+        placements = [[(i, L)] for i in range(B)] + [[] for _ in range(R - B)]
+        return (
+            RowPackedBatch(data={}, placements=placements, row_len=L),
+            data,
+            L,
+        )
+
+    def _device_batch(self, data, stacked: bool):
+        """Per-key sharding: token arrays use the standard batch spec;
+        patch arrays shard the patch dim over the row axes (rank-1
+        patch_img_ids cannot take the 2-axis token spec)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from areal_tpu.parallel import batch_spec, distributed
+
+        token_spec = batch_spec()
+        row_axes = token_spec[0]
+        specs = {}
+        for k in data:
+            s = P(row_axes) if k in VISION_KEYS else token_spec
+            specs[k] = P(None, *s) if stacked else s
+        if jax.process_count() > 1:
+            return distributed.make_global_batch(self.mesh, specs, data)
+        return {
+            k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+            for k, v in data.items()
+        }
+
+    def _call_model(self, params, batch):
+        mrope = batch.get("mrope_positions")
+        if mrope is not None:
+            mrope = jnp.moveaxis(mrope, -1, 0)  # [B, L, 3] -> [3, B, L]
+        return forward_vlm_lm(
+            params,
+            self.model_config,
+            batch["input_ids"],
+            batch["positions"],
+            batch["segment_ids"],
+            batch["pixel_values"],
+            batch["patch_img_ids"],
+            mrope_positions=mrope,
+            mesh=self.mesh,
+        )
+
+
+class VLMPPOActor:
+    """GRPO actor for the VLM engine.
+
+    Thin delegation instead of a PPOActor subclass: the generic minibatch
+    split (select_rows over B) would slice pixel tensors — whose leading dim
+    is patches, not sequences — so the update runs as ONE engine
+    train_batch over the full batch (ppo_n_minibatches=1 enforced), with
+    vision keys carried through intact.  Advantage/logp computation is
+    inherited behavior via composition with the standard PPOActor.
+    """
+
+    def __init__(self, config, engine: JaxVLMEngine):
+        from areal_tpu.engine.ppo.actor import PPOActor
+
+        if config.ppo_n_minibatches != 1:
+            raise NotImplementedError("VLM GRPO v1: set ppo_n_minibatches=1")
+        if config.dynamic_sampling:
+            raise NotImplementedError(
+                "dynamic sampling reorders sequences away from their pixels"
+            )
+        self._ppo = PPOActor(config, engine)
+        self.config = config
+        self.engine = engine
+
+    def compute_logp(self, batch):
+        return self._ppo.compute_logp(batch)
+
+    def compute_advantages(self, batch):
+        self._ppo.compute_advantages(batch)
+
+    def ppo_update(self, batch):
+        import functools
+
+        import numpy as np
+
+        from areal_tpu.ops.functional import grpo_loss_fn
+
+        cfg = self.config
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = functools.partial(
+                grpo_loss_fn,
+                eps_clip=cfg.eps_clip,
+                c_clip=cfg.c_clip,
+                behav_imp_weight_cap=cfg.behav_imp_weight_cap,
+                temperature=cfg.temperature,
+                use_decoupled_loss=cfg.use_decoupled_loss,
+                eps_clip_higher=cfg.eps_clip_higher,
+            )
+        keys = self._ppo.LOSS_KEYS + VISION_KEYS + ("mrope_positions",)
+        view = {k: batch[k] for k in keys if k in batch}
+        st = self.engine.train_batch(
+            view,
+            self._loss_fn,
+            loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
+        )
+        n = max(st.pop("n_valid_tokens", 1.0), 1.0)
+        for k in (
+            "importance_weight", "approx_kl", "clip_ratio", "dual_clip_ratio",
+            "behave_kl", "behave_imp_weight", "entropy", "new_logp", "old_logp",
+        ):
+            if k in st:
+                st[k] = st[k] / n
+        st["n_tokens"] = n
+        return [st]
+
+
+class JaxVLMPPOActor(JaxVLMEngine):
+    """JaxVLMEngine + VLM GRPO surface (mirrors JaxPPOActor's wiring)."""
+
+    def __init__(self, config, model_config=None):
+        super().__init__(config, model_config)
+        self.actor = VLMPPOActor(config, self)
+
+    def compute_logp(self, batch):
+        return self.actor.compute_logp(batch)
+
+    def compute_advantages(self, batch):
+        self.actor.compute_advantages(batch)
+
+    def ppo_update(self, batch):
+        return self.actor.ppo_update(batch)

@@ -109,6 +109,7 @@ class SlurmLauncher:
         sbatch_bin: str = "sbatch",
         squeue_bin: str = "squeue",
         scancel_bin: str = "scancel",
+        sacct_bin: str = "sacct",
         workdir: Optional[str] = None,
     ):
         self.entry = entry
@@ -119,6 +120,7 @@ class SlurmLauncher:
         self.sbatch_bin = sbatch_bin
         self.squeue_bin = squeue_bin
         self.scancel_bin = scancel_bin
+        self.sacct_bin = sacct_bin
         self.workdir = workdir or os.getcwd()
         self.job_ids: List[str] = []
         nr = self.config.cluster.name_resolve
@@ -212,7 +214,25 @@ class SlurmLauncher:
             text=True,
         )
         state = out.stdout.strip().splitlines()
-        return state[0].strip() if state else "COMPLETED"
+        if state:
+            return state[0].strip()
+        if out.returncode != 0:
+            # squeue itself failed (slurmctld blip): unknown, NOT completed
+            return "UNKNOWN"
+        # gone from the queue: ask the accounting db how it ended; a job
+        # that FAILED between polls must not be reported as COMPLETED
+        try:
+            acct = subprocess.run(
+                [self.sacct_bin, "-j", job_id, "-n", "-X", "-o", "State"],
+                capture_output=True,
+                text=True,
+            )
+        except FileNotFoundError:  # no accounting on this cluster
+            return "COMPLETED"
+        lines = acct.stdout.strip().splitlines()
+        if acct.returncode == 0 and lines:
+            return lines[0].strip().split()[0].rstrip("+")
+        return "COMPLETED"
 
     def cancel_all(self):
         for job_id in self.job_ids:

@@ -235,4 +235,8 @@ def forward_vlm_lm(
     head = params.get("lm_head")
     if head is None:
         head = params["embedding"].T
-    return LMOutput(hidden=hidden, head=head.astype(dtype), aux_loss=None)
+    return LMOutput(
+        hidden=hidden,
+        head=head.astype(dtype),
+        aux_loss=aux * cfg.moe_aux_coef if cfg.num_experts > 0 else None,
+    )

@@ -57,15 +57,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
             i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
             i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
             lib.ffd_assign.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i32p]
             lib.ffd_assign.restype = ctypes.c_int64
             lib.lpt_assign.argtypes = [i64p, ctypes.c_int64, ctypes.c_int64, i32p]
             lib.lpt_assign.restype = None
-            lib.slice_intervals.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u8p]
-            lib.slice_intervals.restype = None
-            lib.set_intervals.argtypes = [u8p, i64p, i64p, ctypes.c_int64, u8p]
-            lib.set_intervals.restype = None
             _LIB = lib
             logger.info(f"native dataplane loaded ({so})")
         except Exception as e:  # noqa: BLE001 — fall back to Python
@@ -98,35 +93,3 @@ def lpt_assign(sizes: Sequence[int], k: int) -> Optional[np.ndarray]:
     out = np.empty(len(s), dtype=np.int32)
     lib.lpt_assign(s, len(s), int(k), out)
     return out
-
-
-def slice_intervals(
-    src: np.ndarray, offsets: Sequence[int], lens: Sequence[int]
-) -> Optional[np.ndarray]:
-    """Gather byte intervals of `src` (uint8 view) into one contiguous
-    array; None if native is unavailable."""
-    lib = _load()
-    if lib is None:
-        return None
-    src = np.ascontiguousarray(src).view(np.uint8).reshape(-1)
-    off = np.ascontiguousarray(offsets, dtype=np.int64)
-    ln = np.ascontiguousarray(lens, dtype=np.int64)
-    out = np.empty(int(ln.sum()), dtype=np.uint8)
-    lib.slice_intervals(src, off, ln, len(off), out)
-    return out
-
-
-def set_intervals(
-    dst: np.ndarray, offsets: Sequence[int], lens: Sequence[int], src: np.ndarray
-) -> bool:
-    """Scatter contiguous `src` bytes into intervals of `dst` in place;
-    False if native is unavailable."""
-    lib = _load()
-    if lib is None:
-        return False
-    dstv = dst.view(np.uint8).reshape(-1)
-    off = np.ascontiguousarray(offsets, dtype=np.int64)
-    ln = np.ascontiguousarray(lens, dtype=np.int64)
-    srcv = np.ascontiguousarray(src).view(np.uint8).reshape(-1)
-    lib.set_intervals(dstv, off, ln, len(off), srcv)
-    return True

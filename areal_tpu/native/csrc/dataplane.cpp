@@ -1,15 +1,12 @@
 // Native data-plane kernels for the host-side hot path.
 //
-// TPU-native counterpart of the reference's csrc/ extensions: the reference
-// ships CUDA interval-copy kernels (csrc/interval_op/interval_op.cu) for
-// gathering/scattering parameter fragments and does its micro-batch
-// bin-packing in Python (areal/utils/datapack.py ffd_allocate).  On TPU the
-// device-side work belongs to XLA; what remains hot on the HOST is
-//   (a) per-batch bin-packing (FFD / LPT) that runs in the rollout->train
-//       handoff for every batch, and
-//   (b) interval slice/set memcpy used when chunking parameter bytes for
-//       the transfer weight-sync path.
-// Compiled with g++ -O3 -shared -fPIC, loaded via ctypes
+// TPU-native counterpart of the reference's csrc/ extensions.  The
+// reference's CUDA interval-copy kernels (csrc/interval_op/interval_op.cu)
+// serve its flattened-param reallocation, which this design removes (GSPMD
+// resharding replaces live param realloc); its bin-packing runs in Python
+// (areal/utils/datapack.py ffd_allocate).  What remains hot on the HOST
+// here is the per-batch bin-packing (FFD / LPT) in the rollout->train
+// handoff.  Compiled with g++ -O3 -shared -fPIC, loaded via ctypes
 // (areal_tpu/native/__init__.py); every entry point has a pure-Python
 // fallback with identical semantics (parity-tested).
 
@@ -72,28 +69,6 @@ void lpt_assign(const int64_t* sizes, int64_t n, int64_t k,
     }
     loads[best] += sizes[idx];
     group_of[idx] = static_cast<int32_t>(best);
-  }
-}
-
-// Gather byte intervals [src + offsets[i], +lens[i]) into contiguous dst.
-// (reference: csrc/interval_op slice_intervals, host flavor)
-void slice_intervals(const uint8_t* src, const int64_t* offsets,
-                     const int64_t* lens, int64_t n, uint8_t* dst) {
-  int64_t out = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    std::memcpy(dst + out, src + offsets[i], static_cast<size_t>(lens[i]));
-    out += lens[i];
-  }
-}
-
-// Scatter contiguous src back into byte intervals of dst.
-// (reference: csrc/interval_op set_intervals, host flavor)
-void set_intervals(uint8_t* dst, const int64_t* offsets, const int64_t* lens,
-                   int64_t n, const uint8_t* src) {
-  int64_t in = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    std::memcpy(dst + offsets[i], src + in, static_cast<size_t>(lens[i]));
-    in += lens[i];
   }
 }
 

@@ -86,6 +86,11 @@ def aent_grpo_loss_fn(
     # constant-filled array recovers the scalar without a fixed position
     coeff = jnp.max(batch["entropy_coeff"] * loss_mask)
     loss = loss - coeff * jnp.sum(entropy * loss_mask)
+    aux = getattr(model_out, "aux_loss", None)
+    if aux is not None:
+        # MoE load-balance penalty, same fold-in as grpo_loss_fn
+        loss = loss + aux * jnp.sum(loss_mask)
+        stats["moe_aux_loss"] = aux * jnp.sum(loss_mask)
     stats["entropy"] = jnp.sum(entropy * loss_mask)
     stats["new_logp"] = jnp.sum(logprobs * loss_mask)
     stats["old_logp"] = jnp.sum(batch["logprobs"] * loss_mask)

@@ -92,18 +92,23 @@ class AutomaticEvaluator:
         cmd = self.config.eval_cmd.replace("{ckpt}", path).replace("{name}", name)
         logger.info(f"evaluating {name}: {cmd}")
         t0 = time.time()
+        # own session + killpg: a timeout must take down the eval's whole
+        # process tree, or communicate() blocks on grandchildren holding the
+        # pipe and the orphaned job keeps burning the accelerator
+        proc = subprocess.Popen(
+            cmd,
+            shell=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+            env={**os.environ, **self.config.env},
+        )
         try:
-            proc = subprocess.run(
-                cmd,
-                shell=True,
-                capture_output=True,
-                text=True,
-                timeout=self.config.timeout,
-                env={**os.environ, **self.config.env},
-            )
+            stdout, stderr = proc.communicate(timeout=self.config.timeout)
             # convention: the eval prints one JSON line (its metrics) last
             metrics: Optional[dict] = None
-            for line in reversed(proc.stdout.strip().splitlines()):
+            for line in reversed(stdout.strip().splitlines()):
                 try:
                     metrics = json.loads(line)
                     break
@@ -116,8 +121,15 @@ class AutomaticEvaluator:
                 "wall_s": round(time.time() - t0, 1),
             }
             if proc.returncode != 0:
-                result["stderr_tail"] = proc.stderr[-2000:]
+                result["stderr_tail"] = stderr[-2000:]
         except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
             result = {
                 "name": name,
                 "rc": -1,

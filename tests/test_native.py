@@ -76,30 +76,3 @@ def test_datapack_dispatch_matches_python_semantics(monkeypatch):
     monkeypatch.setattr(native, "lpt_assign", lambda *a, **k: None)
     assert datapack.ffd_allocate(sizes, capacity=256, min_groups=3) == with_native
     assert datapack.balanced_partition(sizes, 4) == part_native
-
-
-def test_interval_roundtrip():
-    rng = np.random.default_rng(3)
-    buf = rng.integers(0, 255, 4096).astype(np.uint8)
-    offsets = np.array([0, 100, 1000, 2000], np.int64)
-    lens = np.array([50, 200, 16, 1024], np.int64)
-
-    sliced = native.slice_intervals(buf, offsets, lens)
-    expect = np.concatenate([buf[o : o + l] for o, l in zip(offsets, lens)])
-    np.testing.assert_array_equal(sliced, expect)
-
-    dst = np.zeros_like(buf)
-    assert native.set_intervals(dst, offsets, lens, sliced)
-    for o, l in zip(offsets, lens):
-        np.testing.assert_array_equal(dst[o : o + l], buf[o : o + l])
-    # untouched bytes stay zero
-    assert dst[50:100].sum() == 0
-
-
-def test_interval_typed_arrays():
-    x = np.arange(1024, dtype=np.float32)
-    nbytes = x.dtype.itemsize
-    out = native.slice_intervals(x, [0, 512 * nbytes], [256 * nbytes, 256 * nbytes])
-    back = np.frombuffer(out.tobytes(), dtype=np.float32)
-    np.testing.assert_array_equal(back[:256], x[:256])
-    np.testing.assert_array_equal(back[256:], x[512:768])

@@ -76,9 +76,27 @@ def stub_slurm(tmp_path):
     scancel.write_text(
         f"#!/bin/bash\necho \"$@\" >> {tmp_path}/scancel.calls\n"
     )
-    for p in (sbatch, squeue, scancel):
+    # sacct consulted when squeue no longer lists the job
+    acct_state = tmp_path / "acct_state"
+    acct_state.write_text("COMPLETED")
+    sacct = tmp_path / "sacct"
+    sacct.write_text(f"#!/bin/bash\ncat {acct_state}\n")
+    for p in (sbatch, squeue, scancel, sacct):
         p.chmod(p.stat().st_mode | stat.S_IEXEC)
     return tmp_path, state
+
+
+def _launcher(stub_dir, tmp_path, n_gen=0, n_train=1):
+    return SlurmLauncher(
+        "entry.py",
+        ["--config", _write_cfg(tmp_path)],
+        n_gen_servers=n_gen,
+        n_train_procs=n_train,
+        sbatch_bin=str(stub_dir / "sbatch"),
+        squeue_bin=str(stub_dir / "squeue"),
+        scancel_bin=str(stub_dir / "scancel"),
+        sacct_bin=str(stub_dir / "sacct"),
+    )
 
 
 def _write_cfg(tmp_path):
@@ -101,15 +119,7 @@ def _write_cfg(tmp_path):
 
 def test_submit_babysit_cancel(stub_slurm, tmp_path):
     stub_dir, state = stub_slurm
-    launcher = SlurmLauncher(
-        "entry.py",
-        ["--config", _write_cfg(tmp_path)],
-        n_gen_servers=2,
-        n_train_procs=4,
-        sbatch_bin=str(stub_dir / "sbatch"),
-        squeue_bin=str(stub_dir / "squeue"),
-        scancel_bin=str(stub_dir / "scancel"),
-    )
+    launcher = _launcher(stub_dir, tmp_path, n_gen=2, n_train=4)
     gen_id = launcher.submit(launcher.gen_server_spec())
     train_id = launcher.submit(launcher.trainer_spec())
     assert gen_id != train_id
@@ -137,28 +147,22 @@ def test_submit_babysit_cancel(stub_slurm, tmp_path):
 def test_run_returns_on_completion(stub_slurm, tmp_path):
     stub_dir, state = stub_slurm
     state.write_text("COMPLETED")
-    launcher = SlurmLauncher(
-        "entry.py",
-        ["--config", _write_cfg(tmp_path)],
-        n_gen_servers=0,
-        n_train_procs=1,
-        sbatch_bin=str(stub_dir / "sbatch"),
-        squeue_bin=str(stub_dir / "squeue"),
-        scancel_bin=str(stub_dir / "scancel"),
-    )
-    assert launcher.run(poll_interval=0.01) == 0
+    assert _launcher(stub_dir, tmp_path).run(poll_interval=0.01) == 0
 
     state.write_text("FAILED")
-    launcher2 = SlurmLauncher(
-        "entry.py",
-        ["--config", _write_cfg(tmp_path)],
-        n_gen_servers=0,
-        n_train_procs=1,
-        sbatch_bin=str(stub_dir / "sbatch"),
-        squeue_bin=str(stub_dir / "squeue"),
-        scancel_bin=str(stub_dir / "scancel"),
-    )
-    assert launcher2.run(poll_interval=0.01) == 1
+    assert _launcher(stub_dir, tmp_path).run(poll_interval=0.01) == 1
+
+
+def test_vanished_job_resolved_via_accounting(stub_slurm, tmp_path):
+    """A job gone from squeue between polls is resolved through sacct — a
+    FAILED job must not be reported as a successful run."""
+    stub_dir, state = stub_slurm
+    state.write_text("")  # squeue no longer lists the job
+    (stub_dir / "acct_state").write_text("FAILED")
+    assert _launcher(stub_dir, tmp_path).run(poll_interval=0.01) == 1
+
+    (stub_dir / "acct_state").write_text("COMPLETED")
+    assert _launcher(stub_dir, tmp_path).run(poll_interval=0.01) == 0
 
 
 def test_requires_nfs_name_resolve(tmp_path):
