@@ -51,6 +51,12 @@ class PPOActor:
         "input_ids", "attention_mask", "loss_mask", "logprobs",
         "advantages", "prox_logp",
     )
+    # sum-reduced loss stats normalised to per-token means after each step
+    PER_TOKEN_STAT_KEYS = (
+        "importance_weight", "approx_kl", "clip_ratio", "dual_clip_ratio",
+        "behave_kl", "behave_imp_weight", "entropy", "new_logp", "old_logp",
+        "moe_aux_loss",
+    )
 
     def __init__(self, config: PPOActorConfig, engine):
         self.config = config
@@ -221,39 +227,45 @@ class PPOActor:
         mbs = split_padded_tensor_dict_into_mb_list(
             train_view, n_mbs=cfg.ppo_n_minibatches
         )
-        if not hasattr(self, "_loss_fn"):
-            self._loss_fn = functools.partial(
-                grpo_loss_fn,
-                eps_clip=cfg.eps_clip,
-                c_clip=cfg.c_clip,
-                behav_imp_weight_cap=cfg.behav_imp_weight_cap,
-                temperature=cfg.temperature,
-                use_decoupled_loss=cfg.use_decoupled_loss,
-                eps_clip_higher=cfg.eps_clip_higher,
-            )
         all_stats = []
         for mb in mbs.mbs:
-            st = self.engine.train_batch(
-                mb,
-                self._loss_fn,
-                loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
-            )
-            n = max(st.pop("n_valid_tokens", 1.0), 1.0)
-            # sum-reduced stats -> per-token means
-            for k in (
-                "importance_weight", "approx_kl", "clip_ratio",
-                "dual_clip_ratio", "behave_kl", "behave_imp_weight",
-                "entropy", "new_logp", "old_logp",
-            ):
-                if k in st:
-                    st[k] = st[k] / n
-            st["n_tokens"] = n
-            all_stats.append(st)
-            with stats.DEFAULT_TRACKER.scope("ppo_actor"):
-                stats.DEFAULT_TRACKER.scalar(**{
-                    k: v for k, v in st.items() if np.isscalar(v)
-                })
+            all_stats.append(self._train_one_mb(mb))
         return all_stats
+
+    def _build_loss_fn(self):
+        """The cached grpo loss partial (built ONCE: the compiled step is
+        keyed on the callable's identity)."""
+        cfg = self.config
+        return functools.partial(
+            grpo_loss_fn,
+            eps_clip=cfg.eps_clip,
+            c_clip=cfg.c_clip,
+            behav_imp_weight_cap=cfg.behav_imp_weight_cap,
+            temperature=cfg.temperature,
+            use_decoupled_loss=cfg.use_decoupled_loss,
+            eps_clip_higher=cfg.eps_clip_higher,
+        )
+
+    def _train_one_mb(self, mb: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One train_batch + stat normalisation + tracker commit — shared
+        with VLM/recipe actors so their stats cannot drift from the base."""
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = self._build_loss_fn()
+        st = self.engine.train_batch(
+            mb,
+            self._loss_fn,
+            loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
+        )
+        n = max(st.pop("n_valid_tokens", 1.0), 1.0)
+        for k in self.PER_TOKEN_STAT_KEYS:
+            if k in st:
+                st[k] = st[k] / n
+        st["n_tokens"] = n
+        with stats.DEFAULT_TRACKER.scope("ppo_actor"):
+            stats.DEFAULT_TRACKER.scalar(**{
+                k: v for k, v in st.items() if np.isscalar(v)
+            })
+        return st
 
 
 class JaxPPOActor(JaxTrainEngine):

@@ -209,39 +209,11 @@ class VLMPPOActor:
         self._ppo.compute_advantages(batch)
 
     def ppo_update(self, batch):
-        import functools
-
-        import numpy as np
-
-        from areal_tpu.ops.functional import grpo_loss_fn
-
-        cfg = self.config
-        if not hasattr(self, "_loss_fn"):
-            self._loss_fn = functools.partial(
-                grpo_loss_fn,
-                eps_clip=cfg.eps_clip,
-                c_clip=cfg.c_clip,
-                behav_imp_weight_cap=cfg.behav_imp_weight_cap,
-                temperature=cfg.temperature,
-                use_decoupled_loss=cfg.use_decoupled_loss,
-                eps_clip_higher=cfg.eps_clip_higher,
-            )
         keys = self._ppo.LOSS_KEYS + VISION_KEYS + ("mrope_positions",)
         view = {k: batch[k] for k in keys if k in batch}
-        st = self.engine.train_batch(
-            view,
-            self._loss_fn,
-            loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
-        )
-        n = max(st.pop("n_valid_tokens", 1.0), 1.0)
-        for k in (
-            "importance_weight", "approx_kl", "clip_ratio", "dual_clip_ratio",
-            "behave_kl", "behave_imp_weight", "entropy", "new_logp", "old_logp",
-        ):
-            if k in st:
-                st[k] = st[k] / n
-        st["n_tokens"] = n
-        return [st]
+        # loss construction, stat normalisation, and tracker commit are the
+        # base actor's — one source, no drift
+        return [self._ppo._train_one_mb(view)]
 
 
 class JaxVLMPPOActor(JaxVLMEngine):

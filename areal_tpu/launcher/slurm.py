@@ -227,12 +227,14 @@ class SlurmLauncher:
                 capture_output=True,
                 text=True,
             )
-        except FileNotFoundError:  # no accounting on this cluster
+        except FileNotFoundError:  # no accounting on this cluster at all
             return "COMPLETED"
         lines = acct.stdout.strip().splitlines()
         if acct.returncode == 0 and lines:
             return lines[0].strip().split()[0].rstrip("+")
-        return "COMPLETED"
+        # accounting blip or record not landed yet: keep polling — never
+        # guess COMPLETED for a job we cannot observe
+        return "UNKNOWN"
 
     def cancel_all(self):
         for job_id in self.job_ids:

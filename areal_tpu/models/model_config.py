@@ -79,6 +79,10 @@ class TransformerConfig:
     dtype: str = "bfloat16"  # compute/activation dtype
     param_dtype: str = "float32"  # master weights
     remat: bool = True  # jax.checkpoint each layer
+    # "full": recompute everything in backward (min HBM);
+    # "dots": save matmul outputs, recompute elementwise only — trades HBM
+    # for ~the forward matmul FLOPs of the backward recompute
+    remat_policy: str = "full"  # full | dots
     # attention implementation: "auto" picks the Pallas splash kernel on TPU
     # when shapes allow and the naive einsum path elsewhere (ops/attention.py)
     attn_impl: str = "auto"  # auto | splash | naive

@@ -166,7 +166,17 @@ def _backbone(
 
     layer_fn = functools.partial(_layer_forward, cfg, mesh)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        if cfg.remat_policy == "dots":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "full":
+            layer_fn = jax.checkpoint(layer_fn)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; use 'full' or 'dots'"
+            )
 
     def scan_body(carry, lp):
         x, aux_sum = carry

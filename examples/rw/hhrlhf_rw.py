@@ -1,0 +1,97 @@
+"""HH-RLHF reward-model training entry point.
+
+Behavioral counterpart of the reference's RW path
+(areal/engine/rw/rw_engine.py + hhrlhf dataset): Bradley-Terry pairwise
+loss over interleaved (chosen, rejected) rows.
+
+Launch:  python examples/rw/hhrlhf_rw.py --config examples/rw/hhrlhf_rw.yaml
+"""
+
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import RWConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.engine.rw import JaxRewardModelEngine
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.data import pad_sequences_to_tensors
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = logging.getLogger("hhrlhf_rw")
+
+
+def collate(samples):
+    """Interleave pairs: rows [2i] = chosen, [2i+1] = rejected (the layout
+    engine/rw/rw_engine.py scores)."""
+    rows = []
+    for s in samples:
+        rows.append({"input_ids": np.asarray(s["chosen_ids"], np.int32)})
+        rows.append({"input_ids": np.asarray(s["rejected_ids"], np.int32)})
+    return pad_sequences_to_tensors(rows)
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, RWConfig)
+    seeding.set_random_seed(config.seed, "rw")
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(
+        config.tokenizer_path or config.model.path
+    )
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type,
+        split="train",
+        tokenizer=tokenizer,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    steps_per_epoch = len(dataloader)
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+    engine = JaxRewardModelEngine(config.model)
+    engine.initialize(ft_spec=ft_spec)
+    saver = Saver(config.saver, ft_spec)
+    stats_logger = StatsLogger(config.stats_logger)
+
+    global_step = 0
+    for epoch in range(config.total_train_epochs):
+        for epoch_step, samples in enumerate(dataloader):
+            batch = collate(samples)
+            with stats.DEFAULT_TRACKER.scope("rw"):
+                st = engine.train_rw(batch)
+                stats.DEFAULT_TRACKER.scalar(
+                    **{k: v for k, v in st.items() if np.isscalar(v)}
+                )
+            engine.step_lr_scheduler()
+            saver.save(engine, epoch, epoch_step, global_step, tokenizer=tokenizer)
+            stats_logger.commit(
+                epoch, epoch_step, global_step,
+                [stats.DEFAULT_TRACKER.export()],
+            )
+            logger.info(
+                f"Epoch {epoch + 1}/{config.total_train_epochs} "
+                f"Step {epoch_step + 1}/{steps_per_epoch} done. "
+                f"loss={st['loss']:.4f} acc={st.get('pair_acc', float('nan')):.3f}"
+            )
+            global_step += 1
+    stats_logger.close()
+    engine.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

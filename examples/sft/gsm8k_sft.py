@@ -1,0 +1,130 @@
+"""GSM8K SFT — supervised finetuning entry point.
+
+Behavioral counterpart of the reference's SFT example family
+(examples/ -> areal/engine/sft/lm_engine.py path): tokenize
+(prompt, solution) pairs with the chat template, train the LM loss on the
+solution span only, evaluate perplexity on the valid split each epoch.
+
+Launch:  python examples/sft/gsm8k_sft.py --config examples/sft/gsm8k_sft.yaml
+"""
+
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import SFTConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.engine.sft import JaxLMEngine
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.data import pad_sequences_to_tensors
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = logging.getLogger("gsm8k_sft")
+
+
+def tokenize_sample(sample, tokenizer, max_length):
+    """(messages, answer) -> input_ids + loss_mask over the answer span."""
+    prompt_ids = tokenizer.apply_chat_template(
+        sample["messages"], add_generation_prompt=True, tokenize=True
+    )
+    answer_ids = tokenizer.encode(
+        str(sample["answer"]), add_special_tokens=False
+    )
+    if tokenizer.eos_token_id is not None:
+        answer_ids = answer_ids + [tokenizer.eos_token_id]
+    ids = (prompt_ids + answer_ids)[:max_length]
+    n_prompt = min(len(prompt_ids), len(ids))
+    loss_mask = [0.0] * n_prompt + [1.0] * (len(ids) - n_prompt)
+    return {
+        "input_ids": np.asarray(ids, np.int32),
+        "loss_mask": np.asarray(loss_mask, np.float32),
+    }
+
+
+def collate(samples, tokenizer, max_length):
+    rows = [tokenize_sample(s, tokenizer, max_length) for s in samples]
+    return pad_sequences_to_tensors(rows)
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, SFTConfig)
+    seeding.set_random_seed(config.seed, "sft")
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(
+        config.tokenizer_path or config.model.path
+    )
+
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type,
+        split="train",
+        tokenizer=tokenizer,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    steps_per_epoch = len(dataloader)
+    total_steps = config.total_train_steps or (
+        config.total_train_epochs * steps_per_epoch
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    engine = JaxLMEngine(config.model)
+    engine.initialize(ft_spec=ft_spec)
+    saver = Saver(config.saver, ft_spec)
+    stats_logger = StatsLogger(config.stats_logger)
+    max_len = config.train_dataset.max_length or 1024
+
+    global_step = 0
+    for epoch in range(config.total_train_epochs):
+        for epoch_step, samples in enumerate(dataloader):
+            if global_step >= total_steps:
+                break
+            batch = collate(samples, tokenizer, max_len)
+            with stats.DEFAULT_TRACKER.scope("sft"):
+                st = engine.train_lm(batch)
+                stats.DEFAULT_TRACKER.scalar(
+                    **{k: v for k, v in st.items() if np.isscalar(v)}
+                )
+            engine.step_lr_scheduler()
+            step_info = StepInfo(
+                global_step=global_step,
+                epoch=epoch,
+                epoch_step=epoch_step,
+                steps_per_epoch=steps_per_epoch,
+            )
+            saver.save(engine, epoch, epoch_step, global_step, tokenizer=tokenizer)
+            stats_logger.commit(
+                epoch, epoch_step, global_step,
+                [stats.DEFAULT_TRACKER.export()],
+            )
+            logger.info(
+                f"Epoch {epoch + 1}/{config.total_train_epochs} "
+                f"Step {epoch_step + 1}/{steps_per_epoch} done. "
+                f"loss={st['loss']:.4f} ppl={st['ppl']:.2f}"
+            )
+            global_step += 1
+
+    engine.save(
+        SaveLoadMeta(path=saver.save_path(step_info, "final"), tokenizer=tokenizer)
+    )
+    stats_logger.close()
+    engine.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,35 @@
+"""remat_policy knob: "dots" (save matmul outputs) must agree numerically
+with "full" recompute — it only changes the HBM/FLOPs trade."""
+
+import jax
+import numpy as np
+
+from areal_tpu.models import forward, init_params
+from areal_tpu.models.model_config import tiny_config
+
+
+def test_dots_policy_matches_full():
+    base = tiny_config(vocab_size=64, qkv_bias=True, dtype="float32",
+                       param_dtype="float32")
+    params = init_params(base, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    B, L = 2, 16
+    ids = rng.integers(0, 64, (B, L)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
+    seg = np.zeros((B, L), np.int32)
+
+    def loss(cfg):
+        def f(p):
+            logits = forward(p, cfg, ids, pos, seg)
+            return jax.nn.logsumexp(logits).sum() / (B * L)
+
+        return jax.value_and_grad(f)(params)
+
+    l_full, g_full = loss(base.replace(remat=True, remat_policy="full"))
+    l_dots, g_dots = loss(base.replace(remat=True, remat_policy="dots"))
+    np.testing.assert_allclose(float(l_full), float(l_dots), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g_full,
+        g_dots,
+    )
