@@ -97,6 +97,9 @@ class TrainEngineConfig:
     param_dtype: str = "float32"  # master copy / optimizer dtype
     disable_dropout: bool = True
     gradient_checkpointing: bool = True
+    # "full" recomputes layers in backward (min HBM); "dots" keeps matmul
+    # outputs (faster when HBM allows — v5p-class chips)
+    remat_policy: str = "full"
     mb_spec: "MicroBatchSpec" = field(default_factory=lambda: MicroBatchSpec())
     optimizer: Optional[OptimizerConfig] = field(default_factory=OptimizerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -381,6 +384,11 @@ class GRPOConfig(BaseExperimentConfig):
     gen_server: GenServerConfig = field(default_factory=GenServerConfig)
     actor: PPOActorConfig = field(default_factory=PPOActorConfig)
     ref: Optional[TrainEngineConfig] = None
+    # rollout episode pattern: "rlvr" (single-turn) or "multi_turn"
+    # (retry-with-feedback, reference workflow/multi_turn.py)
+    workflow: str = "rlvr"
+    max_turns: int = 3
+    turn_discount: float = 0.9
 
 
 @dataclass

@@ -152,6 +152,7 @@ class JaxTrainEngine(TrainEngine):
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             remat=cfg.gradient_checkpointing,
+            remat_policy=getattr(cfg, "remat_policy", "full"),
         )
         if getattr(cfg, "lora", None) is not None and cfg.lora.enabled:
             from areal_tpu.models.lora import add_lora_params
@@ -762,6 +763,7 @@ class JaxTrainEngine(TrainEngine):
             dtype=self.config.dtype,
             param_dtype=self.config.param_dtype,
             remat=self.config.gradient_checkpointing,
+            remat_policy=getattr(self.config, "remat_policy", "full"),
             lora_rank=self.model_config.lora_rank if lora_on else 0,
             lora_alpha=self.model_config.lora_alpha,
             lora_targets=self.model_config.lora_targets if lora_on else (),

@@ -1,0 +1,3 @@
+from areal_tpu.evaluation.run_eval import evaluate_checkpoint
+
+__all__ = ["evaluate_checkpoint"]

@@ -1,0 +1,183 @@
+"""Offline benchmark evaluation harness.
+
+Behavioral counterpart of the reference's `evaluation/` directory (offline
+eval of saved checkpoints on math benchmarks, backed by the same
+latex2sympy-class answer grading the reward path uses): load a checkpoint
+into the in-process generation engine, sample k completions per problem
+with the benchmark's template, grade with the math verifier, and report
+pass@1 / pass@k / majority-vote accuracy.
+
+It is the `eval_cmd` target the AutomaticEvaluator sidecar
+(utils/auto_eval.py) is designed to spawn per checkpoint — the last stdout
+line is one JSON metrics object.
+
+Usage:
+    python -m areal_tpu.evaluation.run_eval --ckpt <hf-dir> \
+        --dataset <gsm8k|path.jsonl> [--split test] [--k 1] \
+        [--max-new-tokens 512] [--temperature 0.6] [--limit 200]
+"""
+
+import argparse
+import collections
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("evaluation")
+
+
+def _load_problems(
+    dataset: str, dataset_type: str, split: str, limit: Optional[int]
+) -> List[Dict]:
+    from areal_tpu.dataset import get_custom_dataset
+
+    ds = get_custom_dataset(
+        path=dataset,
+        type=dataset_type or ("gsm8k" if "gsm8k" in dataset else ""),
+        split=split,
+    )
+    problems = list(ds)[: limit or None]
+    if not problems:
+        raise ValueError(f"no problems in {dataset}:{split}")
+    return problems
+
+
+def _messages_of(prob: Dict) -> List[Dict]:
+    if "messages" in prob:
+        m = prob["messages"]
+        return m if isinstance(m, list) else [{"role": "user", "content": m}]
+    return [{"role": "user", "content": prob["question"]}]
+
+
+def evaluate_checkpoint(
+    ckpt: str,
+    dataset: str,
+    dataset_type: str = "",
+    split: str = "test",
+    k: int = 1,
+    max_new_tokens: int = 512,
+    temperature: float = 0.6,
+    top_p: float = 0.95,
+    limit: Optional[int] = None,
+    n_slots: int = 16,
+    max_seq_len: int = 2048,
+    seed: int = 0,
+) -> Dict:
+    from transformers import AutoTokenizer
+
+    from areal_tpu.gen.engine import GenEngine, GenRequest
+    from areal_tpu.models.model_config import TransformerConfig
+    from areal_tpu.reward.math_parser import extract_answer, math_equal
+
+    if max_new_tokens >= max_seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) must be < max_seq_len "
+            f"({max_seq_len}) to leave room for the prompt"
+        )
+    tokenizer = AutoTokenizer.from_pretrained(ckpt)
+    cfg = TransformerConfig.from_hf(ckpt)
+    engine = GenEngine(
+        cfg.replace(dtype="bfloat16"),
+        model_path=ckpt,
+        n_slots=n_slots,
+        max_seq_len=max_seq_len,
+        seed=seed,
+    )
+    problems = _load_problems(dataset, dataset_type, split, limit)
+    logger.info(f"evaluating {ckpt} on {len(problems)} problems, k={k}")
+
+    t0 = time.time()
+    reqs, meta = [], []
+    for i, prob in enumerate(problems):
+        ids = tokenizer.apply_chat_template(
+            _messages_of(prob), add_generation_prompt=True, tokenize=True
+        )
+        ids = ids[-(max_seq_len - max_new_tokens):]
+        for s in range(k):
+            reqs.append(
+                GenRequest(
+                    rid=f"{i}/{s}",
+                    input_ids=list(ids),
+                    max_new_tokens=max_new_tokens,
+                    temperature=0.0 if k == 1 else temperature,
+                    top_p=top_p,
+                    stop_token_ids=(
+                        [tokenizer.eos_token_id]
+                        if tokenizer.eos_token_id is not None
+                        else []
+                    ),
+                )
+            )
+            meta.append(i)
+    engine.generate_blocking(reqs)
+
+    per_problem: Dict[int, List[Optional[str]]] = collections.defaultdict(list)
+    for req, i in zip(reqs, meta):
+        text = tokenizer.decode(req.output_tokens)
+        per_problem[i].append(extract_answer(text))
+
+    pass1 = passk = maj = 0
+    for i, prob in enumerate(problems):
+        gold = str(prob["answer"])
+        preds = per_problem[i]
+        correct = [
+            p is not None and math_equal(p, gold) for p in preds
+        ]
+        pass1 += bool(correct and correct[0])
+        passk += any(correct)
+        counted = collections.Counter(p for p in preds if p is not None)
+        if counted:
+            top_pred = counted.most_common(1)[0][0]
+            maj += bool(math_equal(top_pred, gold))
+    n = len(problems)
+    result = {
+        "ckpt": ckpt,
+        "dataset": dataset,
+        "n_problems": n,
+        "k": k,
+        "pass@1": round(pass1 / n, 4),
+        f"pass@{k}": round(passk / n, 4),
+        "majority": round(maj / n, 4),
+        "wall_s": round(time.time() - t0, 1),
+        "gen_tokens": int(sum(len(r.output_tokens) for r in reqs)),
+    }
+    return result
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt", required=True)
+    p.add_argument("--dataset", required=True)
+    p.add_argument("--type", dest="dataset_type", default="",
+                   help="dataset registry type (default: inferred from path)")
+    p.add_argument("--split", default="test")
+    p.add_argument("--k", type=int, default=1)
+    p.add_argument("--max-new-tokens", type=int, default=512)
+    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--n-slots", type=int, default=16)
+    args = p.parse_args()
+    result = evaluate_checkpoint(
+        ckpt=args.ckpt,
+        dataset=args.dataset,
+        dataset_type=args.dataset_type,
+        split=args.split,
+        k=args.k,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        limit=args.limit,
+        n_slots=args.n_slots,
+        max_seq_len=args.max_seq_len,
+    )
+    logger.info(f"eval result: {result}")
+    print(json.dumps(result))  # last line: the AutomaticEvaluator contract
+
+
+if __name__ == "__main__":
+    main()

@@ -232,6 +232,11 @@ class SlurmLauncher:
         lines = acct.stdout.strip().splitlines()
         if acct.returncode == 0 and lines:
             return lines[0].strip().split()[0].rstrip("+")
+        err = (acct.stderr or "").lower()
+        if "disabled" in err or "no association" in err:
+            # sacct exists but accounting is off: squeue-absence is the only
+            # signal there is — the job left the queue, call it completed
+            return "COMPLETED"
         # accounting blip or record not landed yet: keep polling — never
         # guess COMPLETED for a job we cannot observe
         return "UNKNOWN"
@@ -248,10 +253,20 @@ class SlurmLauncher:
         try:
             gen_id = self.submit(self.gen_server_spec()) if self.n_gen_servers else None
             train_id = self.submit(self.trainer_spec())
+            unknown_streak = 0
             while True:
                 t_state = self.job_state(train_id)
                 if t_state in TERMINAL_STATES:
                     return 0 if t_state == "COMPLETED" else 1
+                # a long streak of UNKNOWN means the control plane cannot
+                # observe the job at all — fail loudly instead of forever
+                unknown_streak = unknown_streak + 1 if t_state == "UNKNOWN" else 0
+                if unknown_streak >= 60:
+                    logger.error(
+                        f"trainer job {train_id} unobservable for "
+                        f"{unknown_streak} polls; giving up"
+                    )
+                    return 1
                 if gen_id is not None:
                     g_state = self.job_state(gen_id)
                     if g_state in TERMINAL_STATES and g_state != "COMPLETED":

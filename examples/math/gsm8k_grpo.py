@@ -111,14 +111,29 @@ def main(argv):
     from areal_tpu.api.reward import prewarm_reward_pool
 
     prewarm_reward_pool()
-    workflow = RLVRWorkflow(
-        reward_fn=gsm8k_reward_fn,
-        gconfig=config.gconfig,
-        tokenizer=tokenizer,
-        dump_dir=os.path.join(
-            StatsLogger.get_log_path(config.stats_logger), "generated"
-        ),
-    )
+    if config.workflow == "multi_turn":
+        from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+        workflow = MultiTurnWorkflow(
+            reward_fn=gsm8k_reward_fn,
+            gconfig=config.gconfig,
+            tokenizer=tokenizer,
+            max_turns=config.max_turns,
+            turn_discount=config.turn_discount,
+        )
+    elif config.workflow != "rlvr":
+        raise ValueError(
+            f"unknown workflow {config.workflow!r}; use 'rlvr' or 'multi_turn'"
+        )
+    else:
+        workflow = RLVRWorkflow(
+            reward_fn=gsm8k_reward_fn,
+            gconfig=config.gconfig,
+            tokenizer=tokenizer,
+            dump_dir=os.path.join(
+                StatsLogger.get_log_path(config.stats_logger), "generated"
+            ),
+        )
     # greedy single-sample workflow for eval (reference :109-117)
     eval_workflow = RLVRWorkflow(
         reward_fn=gsm8k_reward_fn,

@@ -68,9 +68,14 @@ def main(argv):
     saver = Saver(config.saver, ft_spec)
     stats_logger = StatsLogger(config.stats_logger)
 
+    total_steps = config.total_train_steps or (
+        config.total_train_epochs * steps_per_epoch
+    )
     global_step = 0
     for epoch in range(config.total_train_epochs):
         for epoch_step, samples in enumerate(dataloader):
+            if global_step >= total_steps:
+                break
             batch = collate(samples)
             with stats.DEFAULT_TRACKER.scope("rw"):
                 st = engine.train_rw(batch)

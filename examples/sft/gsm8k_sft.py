@@ -90,6 +90,9 @@ def main(argv):
     max_len = config.train_dataset.max_length or 1024
 
     global_step = 0
+    step_info = StepInfo(
+        global_step=0, epoch=0, epoch_step=0, steps_per_epoch=steps_per_epoch
+    )
     for epoch in range(config.total_train_epochs):
         for epoch_step, samples in enumerate(dataloader):
             if global_step >= total_steps:

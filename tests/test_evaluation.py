@@ -1,0 +1,64 @@
+"""Offline eval harness: run the real CLI against a tiny checkpoint and a
+tiny gsm8k jsonl (reference: evaluation/ offline benchmark eval)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.fixtures import make_gsm8k_jsonl, make_tiny_ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_eval_cli_end_to_end(tmp_path):
+    ckpt = tmp_path / "model"
+    make_tiny_ckpt(str(ckpt))
+    data = make_gsm8k_jsonl(str(tmp_path / "test.jsonl"), n=6)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "areal_tpu.evaluation.run_eval",
+            "--ckpt", str(ckpt),
+            "--dataset", data,
+            "--k", "2",
+            "--max-new-tokens", "16",
+            "--max-seq-len", "256",
+            "--limit", "4",
+            "--type", "gsm8k",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    metrics = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert metrics["n_problems"] == 4 and metrics["k"] == 2
+    for key in ("pass@1", "pass@2", "majority"):
+        assert 0.0 <= metrics[key] <= 1.0
+    assert metrics["gen_tokens"] > 0
+
+
+def test_evaluate_checkpoint_api(tmp_path):
+    from areal_tpu.evaluation import evaluate_checkpoint
+
+    ckpt = tmp_path / "model"
+    make_tiny_ckpt(str(ckpt))
+    data = make_gsm8k_jsonl(str(tmp_path / "t.jsonl"), n=3)
+    result = evaluate_checkpoint(
+        ckpt=str(ckpt),
+        dataset=data,
+        dataset_type="gsm8k",
+        k=1,
+        max_new_tokens=8,
+        max_seq_len=128,
+        n_slots=4,
+        limit=2,
+    )
+    assert result["n_problems"] == 2
+    assert "pass@1" in result
