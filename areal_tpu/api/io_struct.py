@@ -33,6 +33,10 @@ class ModelRequest:
     tokenizer: Any = None
     image_data: Optional[List[Any]] = None
     processor: Any = None
+    # native VLM serving wire format (gen/server.py): pre-patchified pixels
+    # + per-image patch grids, the AutoProcessor's output layout
+    pixel_values: Optional[Any] = None  # np [N, patch_dim]
+    image_grid_thw: Optional[Any] = None  # np [n_img, 3]
 
     def copy(self) -> "ModelRequest":
         return ModelRequest(
@@ -43,6 +47,8 @@ class ModelRequest:
             tokenizer=self.tokenizer,
             image_data=list(self.image_data) if self.image_data is not None else None,
             processor=self.processor,
+            pixel_values=self.pixel_values,
+            image_grid_thw=self.image_grid_thw,
         )
 
 

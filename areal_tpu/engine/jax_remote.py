@@ -45,6 +45,17 @@ class JaxBackend:
                 "frequency_penalty": g.frequency_penalty,
             },
         }
+        if req.pixel_values is not None:
+            import base64
+
+            import numpy as np
+
+            pv = np.ascontiguousarray(req.pixel_values, dtype=np.float32)
+            payload["pixel_values_b64"] = base64.b64encode(pv.tobytes()).decode()
+            payload["pixel_values_shape"] = list(pv.shape)
+            payload["image_grid_thw"] = (
+                np.asarray(req.image_grid_thw).reshape(-1, 3).tolist()
+            )
         return HttpRequest(endpoint="/generate", payload=payload)
 
     def parse_generation_response(self, resp: Dict[str, Any]) -> HttpGenerationResult:

@@ -61,7 +61,7 @@ def evaluate_checkpoint(
     split: str = "test",
     k: int = 1,
     max_new_tokens: int = 512,
-    temperature: float = 0.6,
+    temperature: Optional[float] = None,  # None: greedy at k=1, 0.6 at k>1
     top_p: float = 0.95,
     limit: Optional[int] = None,
     n_slots: int = 16,
@@ -104,7 +104,13 @@ def evaluate_checkpoint(
                     rid=f"{i}/{s}",
                     input_ids=list(ids),
                     max_new_tokens=max_new_tokens,
-                    temperature=0.0 if k == 1 else temperature,
+                    # explicit --temperature always wins; the default is
+                    # greedy pass@1 / sampled pass@k
+                    temperature=(
+                        temperature
+                        if temperature is not None
+                        else (0.0 if k == 1 else 0.6)
+                    ),
                     top_p=top_p,
                     stop_token_ids=(
                         [tokenizer.eos_token_id]
@@ -158,7 +164,8 @@ def main():
     p.add_argument("--split", default="test")
     p.add_argument("--k", type=int, default=1)
     p.add_argument("--max-new-tokens", type=int, default=512)
-    p.add_argument("--temperature", type=float, default=0.6)
+    p.add_argument("--temperature", type=float, default=None,
+                   help="default: greedy when k=1, 0.6 when k>1")
     p.add_argument("--limit", type=int, default=None)
     p.add_argument("--max-seq-len", type=int, default=2048)
     p.add_argument("--n-slots", type=int, default=16)

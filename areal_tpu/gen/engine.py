@@ -69,6 +69,10 @@ class GenRequest:
     top_p: float = 1.0
     top_k: int = 0
     stop_token_ids: List[int] = field(default_factory=list)
+    # vision inputs (VLM serving): pre-patchified pixels in image order and
+    # the per-image (t, h, w) patch grids — the AutoProcessor wire format
+    pixel_values: Optional["np.ndarray"] = None  # [N, patch_dim]
+    image_grid_thw: Optional["np.ndarray"] = None  # [n_img, 3]
     # filled by the engine
     output_tokens: List[int] = field(default_factory=list)
     output_logprobs: List[float] = field(default_factory=list)
@@ -118,6 +122,26 @@ class GenEngine:
         # reuses the trainer's partition specs with dp=fsdp=sp=1
         self.mesh = build_mesh(dp=1, fsdp=1, sp=1, tp=tp, devices=devices)
         self._pspecs = param_partition_specs(self.model_config, tp=tp)
+        if self.model_config.vision is not None:
+            # VLM: materialise a scratch tower if the checkpoint lacks one
+            # (mirrors JaxVLMEngine.initialize) and replicate it — the tower
+            # is small relative to the decoder
+            from areal_tpu.models.vision import init_vision_params
+
+            params = dict(params)
+            if "vision" not in params:
+                logger.warning(
+                    "VLM config but the checkpoint has no visual.* weights; "
+                    "initialising a RANDOM vision tower — image-conditioned "
+                    "outputs will be noise until real weights are loaded"
+                )
+                params["vision"] = init_vision_params(
+                    self.model_config.vision, jax.random.PRNGKey(seed + 1)
+                )
+            self._pspecs = dict(self._pspecs)
+            self._pspecs["vision"] = jax.tree_util.tree_map(
+                lambda _: P(), params["vision"]
+            )
         self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.n_slots = n_slots
         self.max_seq_len = max_seq_len
@@ -138,6 +162,9 @@ class GenEngine:
         S = n_slots + 1
         self.slot_req: List[Optional[GenRequest]] = [None] * S
         self.lengths = np.zeros(S, np.int32)
+        # logical rope position per slot; equals lengths for text slots,
+        # trails it for VLM slots (mrope compresses image placeholder runs)
+        self.rope_pos = np.zeros(S, np.int32)
         self.last_tokens = np.zeros(S, np.int32)
         self.temperature = np.ones(S, np.float32)
         self.top_p = np.ones(S, np.float32)
@@ -159,26 +186,79 @@ class GenEngine:
             tok, logp = sample_tokens(logits.astype(jnp.float32), rng, temp, tk, tp)
             return tok, logp, cache
 
-        def _decode_chunk(params, cache, tokens, lengths, rng, temp, tp, tk, n):
+        def _decode_chunk(
+            params, cache, tokens, lengths, rope_pos, rng, temp, tp, tk, n
+        ):
             def body(carry, _):
-                cache, tokens, lengths, rng = carry
-                logits, cache = forward_decode(params, cfg, tokens, lengths, cache)
+                cache, tokens, lengths, rope_pos, rng = carry
+                logits, cache = forward_decode(
+                    params, cfg, tokens, lengths, cache,
+                    rope_positions=rope_pos,
+                )
                 rng, sub = jax.random.split(rng)
                 tok, logp = sample_tokens(
                     logits.astype(jnp.float32), sub, temp, tk, tp
                 )
-                return (cache, tok, lengths + 1, rng), (tok, logp)
+                return (cache, tok, lengths + 1, rope_pos + 1, rng), (tok, logp)
 
-            (cache, _, _, _), (toks, logps) = jax.lax.scan(
-                body, (cache, tokens, lengths, rng), None, length=n
+            (cache, _, _, _, _), (toks, logps) = jax.lax.scan(
+                body, (cache, tokens, lengths, rope_pos, rng), None, length=n
             )
             # one fused download: tokens are exactly representable in f32
             out = jnp.stack([toks.astype(jnp.float32), logps])  # [2, n, S]
             return out, cache
 
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
-        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(8,),
+        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(9,),
                                   donate_argnums=(1,))
+        self._init_vlm()
+
+    def _init_vlm(self) -> None:
+        """Compile the vision tower + image-conditioned prefill when the
+        model is a VLM (cfg.vision set and the checkpoint carries a tower);
+        text-only engines skip all of it."""
+        cfg = self.model_config
+        self._vlm = (
+            cfg.vision is not None
+            and cfg.image_token_id is not None
+            and cfg.mrope_section is not None
+            and isinstance(self.params, dict)
+            and "vision" in self.params
+        )
+        if not self._vlm:
+            return
+        from areal_tpu.models.vision import (
+            merge_image_embeds,
+            mrope_cos_sin,
+            vision_forward,
+        )
+
+        vcfg = cfg.vision
+
+        def _embed_images(vparams, pv, img_ids):
+            return vision_forward(vparams, vcfg, pv, img_ids)
+
+        def _vlm_prefill(
+            params, cache, ids, mpos, image_embeds, plen, slot_ids,
+            rng, temp, tp, tk,
+        ):
+            dtype = jnp.dtype(cfg.dtype)
+            text = jnp.take(params["embedding"].astype(dtype), ids, axis=0)
+            x = merge_image_embeds(text, ids, image_embeds, cfg.image_token_id)
+            rope = mrope_cos_sin(
+                mpos, cfg.head_dim_, cfg.rope_theta, cfg.mrope_section
+            )
+            logits, cache = forward_prefill(
+                params, cfg, ids, plen, cache, slot_ids,
+                inputs_embeds=x, rope=rope,
+            )
+            tok, logp = sample_tokens(
+                logits.astype(jnp.float32), rng, temp, tk, tp
+            )
+            return tok, logp, cache
+
+        self._embed_images_fn = jax.jit(_embed_images)
+        self._vlm_prefill_fn = jax.jit(_vlm_prefill, donate_argnums=(1,))
 
     # ------------------------------------------------------------------
     # submission / weights
@@ -230,6 +310,11 @@ class GenEngine:
                 # while the trainer is at N (staleness gates compare them)
                 version = dir_version
             params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
+        if self.model_config.vision is not None and "vision" not in params:
+            # text-only update for a VLM: keep the current tower (already
+            # sharded on device; device_put under the same spec is a no-op)
+            params = dict(params)
+            params["vision"] = self.params["vision"]
         self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.version = version if version is not None else self.version + 1
         return self.version
@@ -267,12 +352,32 @@ class GenEngine:
         (round-1 review weak #2)."""
         free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
         admitted: List[tuple] = []  # (slot, req)
+        vlm_admitted: List[tuple] = []
         while free:
             try:
                 req = self.pending.get_nowait()
             except queue.Empty:
                 break
-            admitted.append((free.pop(0), req))
+            if req.pixel_values is not None:
+                if not self._vlm:
+                    # "length" terminates the client's interruption loop;
+                    # "abort" would make it resubmit the same request forever
+                    req.finish("length")
+                    logger.error(
+                        f"request {req.rid} carries pixels but the model is "
+                        "text-only; returned empty (config mismatch)"
+                    )
+                    continue
+                err = self._validate_vlm_request(req)
+                if err:
+                    req.finish("length")
+                    logger.error(f"rejecting VLM request {req.rid}: {err}")
+                    continue
+                vlm_admitted.append((free.pop(0), req))
+            else:
+                admitted.append((free.pop(0), req))
+        if vlm_admitted:
+            self._admit_vlm_batch(vlm_admitted)
         if not admitted:
             return
         bucket = round_up_to_bucket(
@@ -312,11 +417,141 @@ class GenEngine:
             for i, (s, req) in enumerate(admitted):
                 self.slot_req[s] = req
                 self.lengths[s] = plens[i]
+                self.rope_pos[s] = plens[i]
                 self.last_tokens[s] = int(toks[i])
                 self.temperature[s] = req.temperature
                 self.top_p[s] = req.top_p
                 self.top_k[s] = req.top_k
         for i, (s, req) in enumerate(admitted):
+            self._record_token(s, int(toks[i]), float(logps[i]))
+
+    def _validate_vlm_request(self, req: GenRequest) -> Optional[str]:
+        """Reject malformed wire inputs BEFORE they reach the decode worker:
+        a bad grid must not hang or abort-storm the whole server."""
+        cfg = self.model_config
+        m = cfg.vision.spatial_merge_size
+        try:
+            grid = np.asarray(req.image_grid_thw, np.int64).reshape(-1, 3)
+            pv = np.asarray(req.pixel_values)
+        except (ValueError, TypeError) as e:
+            return f"malformed pixel inputs: {e}"
+        if pv.ndim != 2 or pv.shape[1] != cfg.vision.patch_dim:
+            return (
+                f"pixel_values shape {pv.shape} != [N, {cfg.vision.patch_dim}]"
+            )
+        if (grid <= 0).any():
+            return f"non-positive grid entries: {grid.tolist()}"
+        if ((grid[:, 1] % m) != 0).any() or ((grid[:, 2] % m) != 0).any():
+            return f"grid h/w must divide merge size {m}: {grid.tolist()}"
+        n_patches = int((grid[:, 0] * grid[:, 1] * grid[:, 2]).sum())
+        if n_patches != pv.shape[0]:
+            return f"grid implies {n_patches} patches, got {pv.shape[0]}"
+        n_placeholders = int(
+            np.sum(np.asarray(req.input_ids) == cfg.image_token_id)
+        )
+        expected = int(
+            (grid[:, 0] * (grid[:, 1] // m) * (grid[:, 2] // m)).sum()
+        )
+        if n_placeholders != expected:
+            return (
+                f"{n_placeholders} image placeholders but grids imply "
+                f"{expected} merged embeddings"
+            )
+        return None
+
+    def _admit_vlm_batch(self, vlm_admitted: List[tuple]) -> None:
+        """Image-conditioned prefill for a batch of requests: ONE vision
+        tower call over all patches and ONE bucketed prefill (the same
+        O(log)-programs admission discipline as the text path).  Merged
+        embeddings concatenate in request order, which matches the
+        flattened row order the in-prefill scatter consumes; each slot's
+        logical rope position continues past its images' compressed extent
+        while the cache index tracks real tokens."""
+        from areal_tpu.models.vision import mrope_position_ids
+
+        cfg = self.model_config
+        m2 = cfg.vision.spatial_merge_size ** 2
+        bucket = round_up_to_bucket(
+            max(len(r.input_ids) for _, r in vlm_admitted),
+            self.prompt_bucket,
+            self.max_seq_len,
+        )
+        S = 1 << (len(vlm_admitted) - 1).bit_length()
+        ids = np.zeros((S, bucket), np.int32)
+        mpos = np.zeros((3, S, bucket), np.int32)
+        plens = np.ones(S, np.int32)
+        slot_ids = np.full(S, self.n_slots, np.int32)
+        temp = np.ones(S, np.float32)
+        top_p = np.ones(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        rope_next = np.zeros(S, np.int32)
+        pv_parts, grids = [], []
+        for i, (s, req) in enumerate(vlm_admitted):
+            r_ids = np.asarray(req.input_ids, np.int32)
+            n = len(r_ids)
+            ids[i, :n] = r_ids
+            plens[i] = n
+            slot_ids[i] = s
+            temp[i] = req.temperature
+            top_p[i] = req.top_p
+            top_k[i] = req.top_k
+            grid = np.asarray(req.image_grid_thw, np.int64).reshape(-1, 3)
+            r_mpos = mrope_position_ids(
+                r_ids, grid, cfg.image_token_id,
+                spatial_merge_size=cfg.vision.spatial_merge_size,
+            )
+            mpos[:, i, :n] = r_mpos
+            rope_next[i] = int(r_mpos.max()) + 1
+            pv_parts.append(np.asarray(req.pixel_values, np.float32))
+            grids.append(grid)
+
+        pv_all = np.concatenate(pv_parts, axis=0)
+        n_patches = pv_all.shape[0]
+        # bucket the patch count (pow2 multiples of the merge group) so the
+        # vision jit compiles O(log) variants; pad patches carry img id -1
+        n_pad = m2 * (
+            1 << max(0, (max(1, (n_patches + m2 - 1) // m2) - 1).bit_length())
+        )
+        pv_pad = np.zeros((n_pad, pv_all.shape[1]), np.float32)
+        pv_pad[:n_patches] = pv_all
+        img_ids = np.full(n_pad, -1, np.int32)
+        ofs = gid = 0
+        for grid in grids:
+            for t, h, w in grid:
+                n = int(t * h * w)
+                img_ids[ofs : ofs + n] = gid
+                ofs += n
+                gid += 1
+        embeds = self._embed_images_fn(
+            self.params["vision"],
+            jnp.asarray(pv_pad, jnp.dtype(cfg.dtype)),
+            jnp.asarray(img_ids),
+        )
+        self.rng, sub = jax.random.split(self.rng)
+        toks, logps, self.cache = self._vlm_prefill_fn(
+            self.params,
+            self.cache,
+            ids,
+            jnp.asarray(mpos),
+            embeds,
+            jnp.asarray(plens),
+            jnp.asarray(slot_ids),
+            sub,
+            jnp.asarray(temp),
+            jnp.asarray(top_p),
+            jnp.asarray(top_k),
+        )
+        toks, logps = np.asarray(toks), np.asarray(logps)
+        with self._lock:
+            for i, (s, req) in enumerate(vlm_admitted):
+                self.slot_req[s] = req
+                self.lengths[s] = plens[i]
+                self.rope_pos[s] = rope_next[i]
+                self.last_tokens[s] = int(toks[i])
+                self.temperature[s] = req.temperature
+                self.top_p[s] = req.top_p
+                self.top_k[s] = req.top_k
+        for i, (s, req) in enumerate(vlm_admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
     def _record_token(self, s: int, tok: int, logp: float) -> None:
@@ -369,6 +604,7 @@ class GenEngine:
             self.cache,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.lengths),
+            jnp.asarray(self.rope_pos),
             sub,
             jnp.asarray(self.temperature),
             jnp.asarray(self.top_p),
@@ -384,6 +620,7 @@ class GenEngine:
                 if self.slot_req[s] is None:
                     break  # stopped mid-chunk; remaining tokens are overshoot
                 self.lengths[s] += 1  # K/V for this token is in the cache
+                self.rope_pos[s] += 1
                 self.last_tokens[s] = toks[i, s]
                 self._record_token(s, int(toks[i, s]), float(logps[i, s]))
                 delivered += 1

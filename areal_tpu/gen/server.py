@@ -103,6 +103,13 @@ class GenServer:
         def on_done(r: GenRequest):
             loop.call_soon_threadsafe(fut.set_result, r)
 
+        pixel_values = None
+        image_grid_thw = None
+        if body.get("pixel_values_b64"):
+            pixel_values = np.frombuffer(
+                base64.b64decode(body["pixel_values_b64"]), dtype=np.float32
+            ).reshape(body["pixel_values_shape"])
+            image_grid_thw = np.asarray(body["image_grid_thw"], np.int64)
         req = GenRequest(
             rid=body.get("rid", ""),
             input_ids=[int(t) for t in body["input_ids"]],
@@ -112,6 +119,8 @@ class GenServer:
             top_p=float(sp.get("top_p", 1.0)),
             top_k=int(sp.get("top_k", 0)),
             stop_token_ids=[int(t) for t in sp.get("stop_token_ids", [])],
+            pixel_values=pixel_values,
+            image_grid_thw=image_grid_thw,
             on_done=on_done,
         )
         self.engine.submit(req)

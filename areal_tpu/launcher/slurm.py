@@ -234,9 +234,10 @@ class SlurmLauncher:
             return lines[0].strip().split()[0].rstrip("+")
         err = (acct.stderr or "").lower()
         if "disabled" in err or "no association" in err:
-            # sacct exists but accounting is off: squeue-absence is the only
-            # signal there is — the job left the queue, call it completed
-            return "COMPLETED"
+            # sacct exists but accounting is off: the job left the queue and
+            # its outcome is unknowable — report that distinctly instead of
+            # claiming success for a possibly-crashed trainer
+            return "VANISHED"
         # accounting blip or record not landed yet: keep polling — never
         # guess COMPLETED for a job we cannot observe
         return "UNKNOWN"
@@ -256,6 +257,13 @@ class SlurmLauncher:
             unknown_streak = 0
             while True:
                 t_state = self.job_state(train_id)
+                if t_state == "VANISHED":
+                    logger.warning(
+                        f"trainer job {train_id} left the queue but the "
+                        "cluster has no accounting; outcome unknown (rc 2) "
+                        "— enable slurm accounting for reliable exit codes"
+                    )
+                    return 2
                 if t_state in TERMINAL_STATES:
                     return 0 if t_state == "COMPLETED" else 1
                 # a long streak of UNKNOWN means the control plane cannot

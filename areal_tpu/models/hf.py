@@ -45,6 +45,25 @@ _LAYER_MAP = {
     "post_attention_layernorm.weight": (("post_attn_norm",), False),
 }
 
+# vision tower (models/vision.py tree) <-> "visual."-prefixed names, the
+# qwen2-VL naming convention; weights store [in, out], HF linears [out, in]
+_VISION_RE = re.compile(r"visual\.blocks\.(\d+)\.(.+)")
+_VISION_LAYER_MAP = {
+    "norm1.weight": (("input_norm",), False),
+    "attn.qkv.weight": (("wqkv",), True),
+    "attn.proj.weight": (("wo",), True),
+    "norm2.weight": (("post_attn_norm",), False),
+    "mlp.up.weight": (("w_up",), True),
+    "mlp.gate.weight": (("w_gate",), True),
+    "mlp.down.weight": (("w_down",), True),
+}
+_VISION_TOP_MAP = {  # name -> (key, transpose)
+    "visual.patch_embed.weight": ("patch_embed", False),
+    "visual.merger.ln.weight": ("merger_norm", False),
+    "visual.merger.fc1.weight": ("merger_fc1", True),
+    "visual.merger.fc2.weight": ("merger_fc2", True),
+}
+
 
 def _set_nested(tree: Dict, path: Tuple[str, ...], value):
     for p in path[:-1]:
@@ -99,9 +118,43 @@ def state_to_params(
             _set_nested(params["layers"], path_in_layer, buf)
             return buf
 
+    Lv = cfg.vision.num_layers if cfg.vision is not None else 0
+    vision: Dict[str, Any] = {"layers": {}}
+    vision_fill: Dict[Tuple[str, ...], int] = {}
+
+    def vision_layer_buf(path_in_layer: Tuple[str, ...], shape):
+        try:
+            return _get_nested(vision["layers"], path_in_layer)
+        except KeyError:
+            buf = np.zeros((Lv, *shape), dtype=np_dtype)
+            _set_nested(vision["layers"], path_in_layer, buf)
+            return buf
+
     seen_head = False
     for name, arr in items:
         arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; astype below handles it
+        if name.startswith("visual."):
+            if cfg.vision is None:
+                logger.warning("skipping vision weight %s (text-only config)", name)
+                continue
+            vm = _VISION_RE.match(name)
+            if vm:
+                idx, suffix = int(vm.group(1)), vm.group(2)
+                if suffix not in _VISION_LAYER_MAP:
+                    logger.warning("skipping unmapped weight %s", name)
+                    continue
+                path_in_layer, transpose = _VISION_LAYER_MAP[suffix]
+                if transpose:
+                    arr = arr.T
+                buf = vision_layer_buf(path_in_layer, arr.shape)
+                buf[idx] = arr.astype(np_dtype)
+                vision_fill[path_in_layer] = vision_fill.get(path_in_layer, 0) + 1
+            elif name in _VISION_TOP_MAP:
+                key, transpose = _VISION_TOP_MAP[name]
+                vision[key] = (arr.T if transpose else arr).astype(np_dtype)
+            else:
+                logger.warning("skipping unmapped weight %s", name)
+            continue
         m = _LAYER_RE.match(name)
         if m:
             idx, suffix = int(m.group(1)), m.group(2)
@@ -136,6 +189,17 @@ def state_to_params(
         del params["lm_head"]
     if not cfg.tie_word_embeddings and not seen_head:
         raise ValueError("untied config but checkpoint has no lm_head.weight")
+    if vision_fill or "patch_embed" in vision:
+        for path_in_layer, n in vision_fill.items():
+            if n != Lv:
+                raise ValueError(
+                    f"incomplete vision weights: {'.'.join(path_in_layer)} "
+                    f"filled for {n}/{Lv} layers"
+                )
+        for required in ("patch_embed", "merger_norm", "merger_fc1", "merger_fc2"):
+            if required not in vision:
+                raise ValueError(f"checkpoint missing visual {required}")
+        params["vision"] = vision
     return params
 
 
@@ -172,6 +236,19 @@ def params_to_hf_state(
         yield "lm_head.weight", np.asarray(params["lm_head"]).T
     elif not cfg.tie_word_embeddings:
         raise ValueError("untied config but params have no lm_head")
+    if "vision" in params and cfg.vision is not None:
+        vision = params["vision"]
+        for name, (key, transpose) in _VISION_TOP_MAP.items():
+            arr = np.asarray(vision[key])
+            yield name, arr.T if transpose else arr
+        for i in range(cfg.vision.num_layers):
+            for suffix, (path_in_layer, transpose) in _VISION_LAYER_MAP.items():
+                buf = _get_nested(vision["layers"], path_in_layer)
+                arr = np.asarray(buf[i])
+                yield (
+                    f"visual.blocks.{i}.{suffix}",
+                    arr.T if transpose else arr,
+                )
 
 
 def save_hf_checkpoint(

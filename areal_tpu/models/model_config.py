@@ -169,6 +169,30 @@ class TransformerConfig:
             hf_architecture=arch,
             bos_token_id=d.get("bos_token_id", 1),
             eos_token_id=eos,
+            # qwen2-VL-style vision config (this repo's saver emits the same
+            # shape, so VLM checkpoints round-trip)
+            vision=(
+                VisionConfig(
+                    patch_size=vd.get("patch_size", 14),
+                    temporal_patch_size=vd.get("temporal_patch_size", 2),
+                    in_channels=vd.get("in_channels", 3),
+                    hidden_size=vd.get("hidden_size", 1280),
+                    intermediate_size=vd.get("intermediate_size", 5120),
+                    num_layers=vd.get("depth", vd.get("num_hidden_layers", 32)),
+                    num_heads=vd.get("num_heads", 16),
+                    spatial_merge_size=vd.get("spatial_merge_size", 2),
+                    out_hidden_size=vd.get("out_hidden_size", d["hidden_size"]),
+                )
+                if (vd := d.get("vision_config")) is not None
+                else None
+            ),
+            image_token_id=d.get("image_token_id"),
+            mrope_section=(
+                tuple(d["rope_scaling"]["mrope_section"])
+                if isinstance(d.get("rope_scaling"), dict)
+                and d["rope_scaling"].get("mrope_section")
+                else None
+            ),
         )
 
     def to_hf_dict(self) -> dict:
@@ -206,6 +230,26 @@ class TransformerConfig:
         if self.sliding_window is not None:
             d["sliding_window"] = self.sliding_window
             d["use_sliding_window"] = True
+        if self.vision is not None:
+            v = self.vision
+            d["vision_config"] = {
+                "patch_size": v.patch_size,
+                "temporal_patch_size": v.temporal_patch_size,
+                "in_channels": v.in_channels,
+                "hidden_size": v.hidden_size,
+                "intermediate_size": v.intermediate_size,
+                "depth": v.num_layers,
+                "num_heads": v.num_heads,
+                "spatial_merge_size": v.spatial_merge_size,
+                "out_hidden_size": v.out_hidden_size,
+            }
+            if self.image_token_id is not None:
+                d["image_token_id"] = self.image_token_id
+            if self.mrope_section is not None:
+                d["rope_scaling"] = {
+                    "type": "mrope",
+                    "mrope_section": list(self.mrope_section),
+                }
         return d
 
 

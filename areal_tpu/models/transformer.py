@@ -341,6 +341,8 @@ def forward_prefill(
     prompt_lens: jax.Array,  # [S]
     cache: Dict[str, jax.Array],
     slot_ids: jax.Array,  # int32 [S]: cache slot each row occupies
+    inputs_embeds: Optional[jax.Array] = None,  # [S, P, D] (VLM merge)
+    rope: Optional[tuple] = None,  # (cos, sin) override (mrope)
 ):
     """Prefill `input_ids` into cache slots `slot_ids` (arbitrary, possibly
     non-contiguous — batched admission fills whichever slots are free);
@@ -351,8 +353,14 @@ def forward_prefill(
     valid = positions < prompt_lens[:, None]
     seg = jnp.where(valid, 0, -1)
     mask = make_attention_mask(seg, positions, cfg.sliding_window)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+    if rope is not None:
+        cos, sin = rope
+    else:
+        cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
+    if inputs_embeds is not None:
+        x = inputs_embeds.astype(dtype)
+    else:
+        x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
 
     def layer(x, xs):
         lp, ck, cv = xs  # ck/cv: [S_total, M, Hkv, hd] for this layer
@@ -388,20 +396,32 @@ def forward_decode(
     tokens: jax.Array,  # [S] last generated token per slot
     lengths: jax.Array,  # [S] current sequence length (cache fill) per slot
     cache: Dict[str, jax.Array],
+    rope_positions: Optional[jax.Array] = None,  # [S] logical rope position
 ):
     """One decode step for every slot; returns (logits [S, V], new cache).
-    The new token's K/V is written at cache position `lengths[s]`."""
+    The new token's K/V is written at cache position `lengths[s]`.
+
+    `rope_positions` separates the rotary position from the cache index:
+    VLM slots compress an image's placeholder run into a small mrope extent,
+    so post-image text continues at a logical position < cache length (for
+    equal (t,h,w) text positions, sectioned mrope equals standard rope, so
+    decode needs only the scalar)."""
     S = tokens.shape[0]
     M = cache["k"].shape[2]
     dtype = jnp.dtype(cfg.dtype)
-    positions = lengths[:, None].astype(jnp.int32)  # [S, 1]
+    rp = lengths if rope_positions is None else rope_positions
+    positions = rp[:, None].astype(jnp.int32)  # [S, 1]
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
     x = jnp.take(params["embedding"].astype(dtype), tokens[:, None], axis=0)
     # attend to cache positions 0..lengths (inclusive: self just written)
     key_pos = jnp.arange(M, dtype=jnp.int32)[None, :]
     attn_mask = (key_pos <= lengths[:, None])[:, None, None, :]  # [S,1,1,M]
     if cfg.sliding_window is not None:
-        attn_mask &= (key_pos > positions - cfg.sliding_window)[:, None, None, :]
+        # window over CACHE indices, not rope positions (they diverge on
+        # VLM slots)
+        attn_mask &= (
+            key_pos > lengths[:, None] - cfg.sliding_window
+        )[:, None, None, :]
     slots = jnp.arange(S)
 
     def layer(x, xs):

@@ -138,9 +138,19 @@ def mrope_position_ids(
     t = 0
     while t < T:
         if input_ids[t] == image_token_id:
+            if img_idx >= len(image_grid_thw):
+                raise ValueError(
+                    f"{img_idx + 1} image placeholder runs but only "
+                    f"{len(image_grid_thw)} grids"
+                )
             gt, gh, gw = (int(v) for v in image_grid_thw[img_idx])
             mh, mw = gh // spatial_merge_size, gw // spatial_merge_size
             n = gt * mh * mw
+            if n <= 0:
+                raise ValueError(
+                    f"image grid {gt}x{gh}x{gw} with merge "
+                    f"{spatial_merge_size} yields no embeddings"
+                )
             tt, hh, ww = np.meshgrid(
                 np.arange(gt), np.arange(mh), np.arange(mw), indexing="ij"
             )

@@ -46,6 +46,8 @@ class VisionRLVRWorkflow(RLVRWorkflow):
 
     def _build_request(self, data: Dict[str, Any]) -> ModelRequest:
         images = load_images(data["images"]) if "images" in data else None
+        pixel_values = data.get("pixel_values")
+        image_grid_thw = data.get("image_grid_thw")
         if "input_ids" in data:
             input_ids = list(data["input_ids"])
         else:
@@ -58,10 +60,17 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             )
             ids = processed["input_ids"]
             input_ids = list(ids[0] if hasattr(ids[0], "__len__") else ids)
+            # the processor's patchified pixels feed the native VLM server
+            # directly (gen/server.py pixel_values_b64 wire field)
+            if pixel_values is None and "pixel_values" in processed:
+                pixel_values = processed["pixel_values"]
+                image_grid_thw = processed.get("image_grid_thw")
         return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=input_ids,
             image_data=image2base64(images) if images is not None else None,
+            pixel_values=pixel_values,
+            image_grid_thw=image_grid_thw,
             gconfig=self.gconfig.new(n_samples=1),
             tokenizer=self.tokenizer,
             processor=self.processor,

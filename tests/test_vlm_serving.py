@@ -1,0 +1,229 @@
+"""VLM serving: image-conditioned prefill + mrope-offset decode in the
+generation engine, and the pixel wire format through the HTTP server
+(reference capability: SGLang/vLLM multimodal serving for
+workflow/vision_rlvr.py)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.gen.engine import GenEngine, GenRequest
+from areal_tpu.models.model_config import VisionConfig, tiny_config
+
+IMG_TOK = 60
+
+VCFG = VisionConfig(
+    patch_size=2,
+    temporal_patch_size=1,
+    in_channels=3,
+    hidden_size=16,
+    intermediate_size=32,
+    num_layers=1,
+    num_heads=2,
+    spatial_merge_size=2,
+    out_hidden_size=48,
+)
+
+
+def _vlm_cfg():
+    return tiny_config(
+        vocab_size=64,
+        hidden_size=48,
+        num_heads=4,
+        num_kv_heads=2,
+        qkv_bias=True,
+        dtype="float32",
+        param_dtype="float32",
+        hf_architecture="Qwen2VLForConditionalGeneration",
+    ).replace(vision=VCFG, image_token_id=IMG_TOK, mrope_section=(2, 3, 3))
+
+
+def _vlm_request(rng, rid="v0", max_new=8, temperature=0.0):
+    # prompt: 2 text tokens, one 4x4-patch image (4 merged placeholders),
+    # 2 text tokens
+    ids = [5, 6] + [IMG_TOK] * 4 + [7, 8]
+    return GenRequest(
+        rid=rid,
+        input_ids=ids,
+        max_new_tokens=max_new,
+        temperature=temperature,
+        pixel_values=rng.normal(size=(16, VCFG.patch_dim)).astype(np.float32),
+        image_grid_thw=np.array([[1, 4, 4]]),
+    )
+
+
+def test_vlm_generation_end_to_end():
+    rng = np.random.default_rng(0)
+    engine = GenEngine(_vlm_cfg(), n_slots=2, max_seq_len=64, seed=0)
+    assert engine._vlm
+    reqs = [_vlm_request(rng, f"v{i}") for i in range(2)]
+    engine.generate_blocking(reqs)
+    for r in reqs:
+        assert r.stop_reason in ("stop", "length")
+        assert len(r.output_tokens) > 0
+        assert len(r.output_logprobs) == len(r.output_tokens)
+
+    # rope positions trail cache lengths on VLM slots: image run of 4
+    # placeholders compressed to extent max(1,2,2)=2 -> offset 8-2-... the
+    # engine freed the slots, but determinism is checked below instead
+
+
+def test_vlm_pixels_change_output_text_does_not_leak():
+    """Same prompt, different pixels -> different greedy continuations;
+    same pixels -> identical (deterministic greedy)."""
+    cfg = _vlm_cfg()
+    rng = np.random.default_rng(1)
+    pix = rng.normal(size=(16, VCFG.patch_dim)).astype(np.float32)
+
+    def run(pixels):
+        engine = GenEngine(cfg, n_slots=1, max_seq_len=64, seed=0)
+        req = _vlm_request(rng, max_new=6)
+        req.pixel_values = pixels
+        engine.generate_blocking([req])
+        return req.output_tokens
+
+    out1 = run(pix)
+    out2 = run(pix)
+    assert out1 == out2, "greedy VLM decode must be deterministic"
+    out3 = run(pix + 1.0)
+    assert out3 != out1, "pixels must condition generation"
+
+
+def test_text_request_on_vlm_engine_still_works():
+    rng = np.random.default_rng(2)
+    engine = GenEngine(_vlm_cfg(), n_slots=2, max_seq_len=64, seed=0)
+    text_req = GenRequest(rid="t", input_ids=[3, 4, 5], max_new_tokens=4,
+                          temperature=0.0)
+    vlm_req = _vlm_request(rng)
+    engine.generate_blocking([text_req, vlm_req])
+    assert text_req.output_tokens and vlm_req.output_tokens
+
+
+def test_pixels_on_text_only_engine_rejected_terminally():
+    """Config mismatch must TERMINATE the request ("length"), not "abort" —
+    abort would put the client interruption loop into infinite resubmit."""
+    rng = np.random.default_rng(3)
+    engine = GenEngine(
+        tiny_config(vocab_size=64, qkv_bias=True), n_slots=1, max_seq_len=64
+    )
+    req = _vlm_request(rng)
+    engine.generate_blocking([req])
+    assert req.stop_reason == "length"
+    assert req.output_tokens == []
+
+
+def test_malformed_vlm_requests_rejected():
+    rng = np.random.default_rng(5)
+    engine = GenEngine(_vlm_cfg(), n_slots=2, max_seq_len=64, seed=0)
+    # grid smaller than the merge size: would loop forever unguarded
+    bad_grid = _vlm_request(rng)
+    bad_grid.image_grid_thw = np.array([[1, 1, 1]])
+    bad_grid.pixel_values = rng.normal(size=(1, VCFG.patch_dim)).astype(np.float32)
+    # patch count inconsistent with the grid
+    bad_count = _vlm_request(rng)
+    bad_count.pixel_values = bad_count.pixel_values[:8]
+    # placeholder count inconsistent with the grid
+    bad_ph = _vlm_request(rng)
+    bad_ph.input_ids = [5, 6, IMG_TOK, 7]  # 1 placeholder, grid implies 4
+    engine.generate_blocking([bad_grid, bad_count, bad_ph])
+    for r in (bad_grid, bad_count, bad_ph):
+        assert r.stop_reason == "length" and r.output_tokens == []
+    # the engine still serves good requests afterwards
+    ok = _vlm_request(rng, rid="ok")
+    engine.generate_blocking([ok])
+    assert ok.output_tokens and ok.stop_reason in ("stop", "length")
+
+
+def test_vlm_checkpoint_roundtrip(tmp_path):
+    """visual.* weights + vision config survive save -> load, so a trained
+    tower actually reaches the server (weights AND config.json)."""
+    import jax
+
+    from areal_tpu.models import init_params
+    from areal_tpu.models.hf import load_hf_params, save_hf_checkpoint
+    from areal_tpu.models.model_config import TransformerConfig
+    from areal_tpu.models.vision import init_vision_params
+
+    cfg = _vlm_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params["vision"] = init_vision_params(VCFG, jax.random.PRNGKey(1))
+    out = tmp_path / "ckpt"
+    save_hf_checkpoint(params, cfg, str(out), save_dtype="float32")
+
+    cfg2 = TransformerConfig.from_hf(str(out))
+    assert cfg2.vision is not None
+    assert cfg2.vision.num_layers == VCFG.num_layers
+    assert cfg2.image_token_id == IMG_TOK
+    assert cfg2.mrope_section == (2, 3, 3)
+
+    loaded, _ = load_hf_params(str(out), cfg2, dtype="float32")
+    assert "vision" in loaded
+    np.testing.assert_allclose(
+        np.asarray(loaded["vision"]["patch_embed"]),
+        np.asarray(params["vision"]["patch_embed"]),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(loaded["vision"]["layers"]["wqkv"]),
+        np.asarray(params["vision"]["layers"]["wqkv"]),
+        rtol=1e-6,
+    )
+
+
+def test_vlm_http_server_roundtrip():
+    """Pixel arrays survive the b64 wire format through the real server."""
+    import base64
+    import json
+    import urllib.request
+
+    import threading
+
+    from areal_tpu.gen.server import GenServer
+    from aiohttp import web
+    import asyncio
+
+    rng = np.random.default_rng(4)
+    engine = GenEngine(_vlm_cfg(), n_slots=2, max_seq_len=64, seed=0)
+    server = GenServer(engine)
+    server.start()
+
+    started = threading.Event()
+    holder = {}
+
+    def _run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def _serve():
+            runner = web.AppRunner(server.app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = runner.addresses[0][1]
+            holder["runner"] = runner
+            started.set()
+
+        loop.run_until_complete(_serve())
+        loop.run_forever()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    assert started.wait(10)
+
+    pv = rng.normal(size=(16, VCFG.patch_dim)).astype(np.float32)
+    payload = {
+        "rid": "wire",
+        "input_ids": [5, 6] + [IMG_TOK] * 4 + [7, 8],
+        "sampling_params": {"max_new_tokens": 4, "temperature": 0.0},
+        "pixel_values_b64": base64.b64encode(pv.tobytes()).decode(),
+        "pixel_values_shape": list(pv.shape),
+        "image_grid_thw": [[1, 4, 4]],
+    }
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{holder['port']}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(r, timeout=120) as resp:
+        out = json.loads(resp.read())
+    assert out["output_tokens"] and out["stop_reason"] in ("stop", "length")
+    server.shutdown.set()
