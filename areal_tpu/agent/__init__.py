@@ -1,5 +1,6 @@
 from areal_tpu.agent.api import Agent, AgentWorkflow, make_agent, register_agent
 from areal_tpu.agent.math_agent import MathMultiTurnAgent, MathSingleStepAgent
+from areal_tpu.agent.tir_agent import TIRMathAgent
 
 __all__ = [
     "Agent",
@@ -8,4 +9,5 @@ __all__ = [
     "register_agent",
     "MathMultiTurnAgent",
     "MathSingleStepAgent",
+    "TIRMathAgent",
 ]

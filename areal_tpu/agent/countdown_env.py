@@ -1,0 +1,160 @@
+"""Countdown arithmetic-game environment + reward.
+
+Capability counterpart of the reference's countdown example family
+(examples/countdown): the model receives a list of numbers and a target and
+must produce an arithmetic expression — inside \\boxed{} or an
+<answer>...</answer> tag — that evaluates to the target using each given
+number at most once (+ - * / and parentheses only).  Verification is a
+safe AST walk, not eval().
+"""
+
+import ast
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from areal_tpu.api.env import Environment
+
+_ANSWER_RES = [
+    re.compile(r"\\boxed\{([^{}]+)\}"),
+    re.compile(r"<answer>(.*?)</answer>", re.DOTALL),
+]
+
+_ALLOWED_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.Div)
+
+
+def extract_expression(text: str) -> Optional[str]:
+    for rx in _ANSWER_RES:
+        found = rx.findall(text)
+        if found:
+            return found[-1].strip()
+    return None
+
+
+def _safe_eval(node: ast.AST, used: List[int]) -> float:
+    """Evaluate the expression tree, recording number literals; raises on
+    anything but numbers, + - * /, parens, and unary minus."""
+    if isinstance(node, ast.Expression):
+        return _safe_eval(node.body, used)
+    if isinstance(node, ast.Constant):
+        if not isinstance(node.value, (int, float)):
+            raise ValueError(f"non-numeric constant {node.value!r}")
+        used.append(int(node.value) if float(node.value).is_integer() else node.value)
+        return float(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_safe_eval(node.operand, used)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, _ALLOWED_BINOPS):
+        left = _safe_eval(node.left, used)
+        right = _safe_eval(node.right, used)
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if right == 0:
+            raise ZeroDivisionError("division by zero")
+        return left / right
+    raise ValueError(f"disallowed syntax: {ast.dump(node)[:60]}")
+
+
+def verify_countdown(
+    completion: str, numbers: Sequence[int], target: float
+) -> float:
+    """1.0 iff the extracted expression evaluates to the target (1e-6
+    tolerance) using each provided number at most once."""
+    expr = extract_expression(completion)
+    if expr is None:
+        return 0.0
+    try:
+        tree = ast.parse(expr, mode="eval")
+        used: List[int] = []
+        value = _safe_eval(tree, used)
+    except (SyntaxError, ValueError, ZeroDivisionError, RecursionError):
+        return 0.0
+    pool = list(numbers)
+    for n in used:
+        if n in pool:
+            pool.remove(n)
+        else:
+            return 0.0  # number not provided (or used twice)
+    return 1.0 if abs(value - float(target)) < 1e-6 else 0.0
+
+
+def countdown_reward_fn(
+    prompt, completions, prompt_ids, completion_ids, **data
+) -> float:
+    """Reward-API entry (same family as gsm8k_reward_fn)."""
+    return verify_countdown(
+        completions, data["numbers"], float(data["target"])
+    )
+
+
+class CountdownEnv(Environment):
+    """verify_answer tool over one episode's (numbers, target)."""
+
+    def __init__(self, numbers: Sequence[int], target: float):
+        self.numbers = list(numbers)
+        self.target = float(target)
+
+    def list_tools(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "name": "verify_answer",
+                "description": "Check a countdown expression against the target.",
+                "parameters": {
+                    "type": "object",
+                    "properties": {"completion": {"type": "string"}},
+                    "required": ["completion"],
+                },
+            }
+        ]
+
+    async def aexecute_tool(
+        self, tool_name: str, arguments: Dict[str, Any]
+    ) -> Tuple[Any, float, bool]:
+        if tool_name != "verify_answer":
+            raise ValueError(f"unknown tool {tool_name!r}")
+        reward = verify_countdown(
+            arguments["completion"], self.numbers, self.target
+        )
+        # done only on success, so multi-turn agents can retry with
+        # feedback (MathVerifyEnv convention: done = reward > 0)
+        return None, reward, reward > 0
+
+
+def make_countdown_dataset(
+    n: int, seed: int = 0, n_numbers: int = 4, max_number: int = 25
+) -> List[Dict[str, Any]]:
+    """Solvable-by-construction problems: compose a random expression from
+    the drawn numbers, use its value as the target."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        while True:
+            numbers = [rng.randint(1, max_number) for _ in range(n_numbers)]
+            value = numbers[0]
+            for x in numbers[1:]:
+                op = rng.choice("+-*")
+                value = value + x if op == "+" else value - x if op == "-" else value * x
+            if 0 < value <= 10_000:
+                break
+        out.append(
+            {
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": (
+                            f"Using the numbers {numbers}, each at most once, "
+                            f"with + - * / and parentheses, write an expression "
+                            f"equal to {value}. Put it in \\boxed{{}}."
+                        ),
+                    }
+                ],
+                "numbers": numbers,
+                "target": value,
+                "query_id": str(i),
+            }
+        )
+    return out

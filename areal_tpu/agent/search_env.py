@@ -1,0 +1,121 @@
+"""Local-corpus search environment for search-agent RL.
+
+Capability counterpart of the reference's search-agent example
+(examples/search-agent, which drives a retrieval service): a `search` tool
+over an in-memory corpus (BM25-lite scoring — no external service, fits
+the no-egress test environment) plus the standard `verify_answer` tool.
+Episodes reward answers whose ground truth matches after retrieval.
+"""
+
+import math
+import re
+from collections import Counter
+from typing import Any, Dict, List, Sequence, Tuple
+
+from areal_tpu.api.env import Environment
+from areal_tpu.reward.math_parser import extract_answer
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _tokens(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+class LocalSearchEnv(Environment):
+    """`search(query, k)` returns the top-k corpus passages by a BM25-style
+    score; `verify_answer(completion)` grades the final answer."""
+
+    def __init__(
+        self,
+        corpus: Sequence[str],
+        answer: str,
+        k1: float = 1.5,
+        b: float = 0.75,
+    ):
+        self.corpus = list(corpus)
+        self.answer = str(answer)
+        self._docs = [_tokens(d) for d in self.corpus]
+        self._tfs = [Counter(toks) for toks in self._docs]
+        self._df: Counter = Counter()
+        for toks in self._docs:
+            self._df.update(set(toks))
+        self._avg_len = (
+            sum(len(t) for t in self._docs) / max(1, len(self._docs))
+        )
+        self.k1 = k1
+        self.b = b
+        self.n_searches = 0
+
+    # ------------------------------------------------------------------
+
+    def _score(self, query_toks: List[str], doc_idx: int) -> float:
+        tf = self._tfs[doc_idx]
+        doc_len = len(self._docs[doc_idx])
+        N = len(self._docs)
+        score = 0.0
+        for q in query_toks:
+            if q not in tf:
+                continue
+            idf = math.log(1 + (N - self._df[q] + 0.5) / (self._df[q] + 0.5))
+            denom = tf[q] + self.k1 * (
+                1 - self.b + self.b * doc_len / max(self._avg_len, 1e-8)
+            )
+            score += idf * tf[q] * (self.k1 + 1) / denom
+        return score
+
+    def search(self, query: str, k: int = 3) -> List[str]:
+        self.n_searches += 1
+        q = _tokens(query)
+        scores = [self._score(q, i) for i in range(len(self.corpus))]
+        ranked = sorted(range(len(scores)), key=scores.__getitem__, reverse=True)
+        return [self.corpus[i] for i in ranked[:k] if scores[i] > 0]
+
+    # ------------------------------------------------------------------
+
+    def list_tools(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "name": "search",
+                "description": "Retrieve top-k passages for a query.",
+                "parameters": {
+                    "type": "object",
+                    "properties": {
+                        "query": {"type": "string"},
+                        "k": {"type": "integer", "default": 3},
+                    },
+                    "required": ["query"],
+                },
+            },
+            {
+                "name": "verify_answer",
+                "description": "Check a final answer against the ground truth.",
+                "parameters": {
+                    "type": "object",
+                    "properties": {"completion": {"type": "string"}},
+                    "required": ["completion"],
+                },
+            },
+        ]
+
+    async def aexecute_tool(
+        self, tool_name: str, arguments: Dict[str, Any]
+    ) -> Tuple[Any, float, bool]:
+        if tool_name == "search":
+            hits = self.search(
+                arguments["query"], int(arguments.get("k", 3))
+            )
+            return hits, 0.0, False  # episode continues
+        if tool_name == "verify_answer":
+            # the answer must be COMMITTED (\boxed / extractable), not merely
+            # present somewhere — echoing a retrieved passage scores 0, or a
+            # paste-the-observations policy farms the reward
+            pred = extract_answer(arguments["completion"])
+            ok = (
+                pred is not None
+                and pred.strip().lower() == self.answer.strip().lower()
+            )
+            # done only on success (MathVerifyEnv convention) so multi-turn
+            # agents can retry
+            return None, float(ok), ok
+        raise ValueError(f"unknown tool {tool_name!r}")

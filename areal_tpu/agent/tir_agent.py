@@ -1,0 +1,160 @@
+"""Tool-integrated reasoning (TIR) agent.
+
+Capability counterpart of the reference's TIR example family
+(examples/tir): the model interleaves reasoning with ```python blocks; the
+agent executes each completed block in the code sandbox
+(reward/code_verifier.py — rlimit'd isolated subprocess) and feeds stdout
+back as an ```output block, then generation continues with the tool result
+in context.  Tool-output tokens are injected, not sampled, so they carry
+loss_mask 0 and logprob 0 — the policy is only trained on what it wrote.
+
+The native generation engine has no server-side stop-strings; the agent
+finds the earliest complete code block in each generation chunk by
+incremental decode and discards the overshoot (the tokens the model
+hallucinated past the block before the tool ran).
+"""
+
+import asyncio
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.agent.api import Agent, register_agent
+from areal_tpu.api.config import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+
+_BLOCK_RE = re.compile(r"```python\s*\n(.*?)```", re.DOTALL)
+
+
+def find_first_block(text: str):
+    """(code, end_char_index) of the first complete ```python block."""
+    m = _BLOCK_RE.search(text)
+    return (m.group(1), m.end()) if m else (None, None)
+
+
+@register_agent("tir-math")
+class TIRMathAgent(Agent):
+    def __init__(
+        self,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        max_tool_calls: int = 4,
+        tool_timeout: float = 6.0,
+        tool_output_chars: int = 1024,
+    ):
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.max_tool_calls = max_tool_calls
+        self.tool_timeout = tool_timeout
+        self.tool_output_chars = tool_output_chars
+
+    # ------------------------------------------------------------------
+
+    def _tokens_until(self, tokens: List[int], end_char: int) -> int:
+        """Smallest k with len(decode(tokens[:k])) >= end_char — the token
+        boundary of a character position, found by bisection (decode is
+        monotone in k)."""
+        lo, hi = 1, len(tokens)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if len(self.tokenizer.decode(tokens[:mid])) >= end_char:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    async def _run_tool(self, code: str) -> str:
+        from areal_tpu.reward.code_verifier import _run_sandboxed
+
+        res = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: _run_sandboxed(code, timeout=self.tool_timeout)
+        )
+        if res.passed:
+            out = res.stdout
+        else:
+            # feed the traceback back — the loop's whole point is letting
+            # the model read the failure and self-correct
+            out = f"{res.reason}\n{res.stderr}".strip()
+        out = out.strip()[: self.tool_output_chars]
+        return f"\n```output\n{out}\n```\n"
+
+    async def _one(self, engine, env, prompt_ids: List[int]):
+        g = self.gconfig
+        ids = list(prompt_ids)
+        gen_mask: List[int] = []  # 1 = sampled by the policy, 0 = injected
+        logprobs: List[float] = []
+        versions: List[int] = []
+        budget = g.max_new_tokens
+        tool_calls = 0
+        while budget > 0:
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=str(uuid.uuid4()),
+                    input_ids=list(ids),
+                    gconfig=g.new(n_samples=1, max_new_tokens=budget),
+                    tokenizer=self.tokenizer,
+                )
+            )
+            text = self.tokenizer.decode(resp.output_tokens)
+            code, end_char = find_first_block(text)
+            if code is not None and tool_calls >= self.max_tool_calls:
+                code = None  # cap reached: keep the text, skip execution
+            if code is None:
+                ids += list(resp.output_tokens)
+                gen_mask += [1] * len(resp.output_tokens)
+                logprobs += list(resp.output_logprobs)
+                versions += list(resp.output_versions)
+                budget -= len(resp.output_tokens)
+                break
+            # keep tokens through the end of the block; overshoot past it
+            # was generated without the tool result and is discarded
+            k = self._tokens_until(list(resp.output_tokens), end_char)
+            ids += list(resp.output_tokens[:k])
+            gen_mask += [1] * k
+            logprobs += list(resp.output_logprobs[:k])
+            versions += list(resp.output_versions[:k])
+            budget -= k
+            tool_calls += 1
+            tool_text = await self._run_tool(code)
+            tool_ids = self.tokenizer.encode(tool_text, add_special_tokens=False)
+            cur_version = versions[-1] if versions else 0
+            ids += list(tool_ids)
+            gen_mask += [0] * len(tool_ids)
+            logprobs += [0.0] * len(tool_ids)
+            versions += [cur_version] * len(tool_ids)
+            budget -= len(tool_ids)
+
+        completion = self.tokenizer.decode(ids[len(prompt_ids):])
+        reward = 0.0
+        if env is not None:
+            _, reward, _ = await env.aexecute_tool(
+                "verify_answer", {"completion": completion}
+            )
+        T = len(ids)
+        n_prompt = len(prompt_ids)
+        loss_mask = np.zeros(T, np.float32)
+        loss_mask[n_prompt:] = np.asarray(gen_mask, np.float32)
+        lp = np.zeros(T, np.float32)
+        lp[n_prompt:] = np.asarray(logprobs, np.float32)
+        ver = np.full(T, -1, np.int32)
+        ver[n_prompt:] = np.asarray(versions, np.int32)
+        return {
+            "input_ids": np.asarray(ids, np.int32),
+            "loss_mask": loss_mask,
+            "logprobs": lp,
+            "versions": ver,
+            "rewards": float(reward),
+        }
+
+    async def collect_trajectory(self, engine, env, data: Dict[str, Any]):
+        from areal_tpu.agent.math_agent import _prompt_ids
+
+        prompt_ids = _prompt_ids(self.tokenizer, data)
+        n = max(1, self.gconfig.n_samples)
+        return list(
+            await asyncio.gather(
+                *[self._one(engine, env, prompt_ids) for _ in range(n)]
+            )
+        )

@@ -1,0 +1,69 @@
+"""Countdown game env/reward tests (reference: examples/countdown)."""
+
+import asyncio
+
+import pytest
+
+from areal_tpu.agent.countdown_env import (
+    CountdownEnv,
+    countdown_reward_fn,
+    extract_expression,
+    make_countdown_dataset,
+    verify_countdown,
+)
+
+
+def test_extract_expression():
+    assert extract_expression("so \\boxed{(1+2)*3}") == "(1+2)*3"
+    assert extract_expression("<answer>4*5</answer>") == "4*5"
+    assert extract_expression("nothing") is None
+    # last answer wins
+    assert extract_expression("\\boxed{1} then \\boxed{2+3}") == "2+3"
+
+
+def test_verify_correct_and_wrong():
+    assert verify_countdown("\\boxed{(25-5)*4}", [25, 5, 4, 7], 80) == 1.0
+    assert verify_countdown("\\boxed{25+5}", [25, 5, 4, 7], 80) == 0.0
+    assert verify_countdown("\\boxed{20*4/1}", [20, 4, 1], 80) == 1.0
+
+
+def test_verify_number_constraints():
+    # 5 used twice but provided once
+    assert verify_countdown("\\boxed{5*5}", [5, 4], 25) == 0.0
+    # number not in the pool
+    assert verify_countdown("\\boxed{10*8}", [5, 4], 80) == 0.0
+    # each number at most once is fine even when unused numbers remain
+    assert verify_countdown("\\boxed{5*4}", [5, 4, 9], 20) == 1.0
+
+
+def test_verify_rejects_unsafe_and_malformed():
+    assert verify_countdown("\\boxed{__import__('os').getcwd()}", [1], 1) == 0.0
+    assert verify_countdown("\\boxed{2**100}", [2, 100], 0) == 0.0  # ** banned
+    assert verify_countdown("\\boxed{1/0}", [1, 0], 1) == 0.0
+    assert verify_countdown("\\boxed{not valid (}", [1], 1) == 0.0
+
+
+def test_reward_fn_and_env():
+    r = countdown_reward_fn(
+        "p", "\\boxed{3*7}", [], [], numbers=[3, 7, 2], target=21
+    )
+    assert r == 1.0
+
+    async def go():
+        async with CountdownEnv([3, 7, 2], 21) as env:
+            _, reward, done = await env.aexecute_tool(
+                "verify_answer", {"completion": "\\boxed{3*7}"}
+            )
+            return reward, done
+
+    reward, done = asyncio.run(go())
+    assert reward == 1.0 and done
+
+
+def test_dataset_solvable_by_construction():
+    ds = make_countdown_dataset(16, seed=1)
+    assert len(ds) == 16
+    for row in ds:
+        assert 0 < row["target"] <= 10_000
+        assert len(row["numbers"]) == 4
+        assert str(row["numbers"]) in row["messages"][0]["content"]

@@ -1,0 +1,49 @@
+"""Local search environment (reference: examples/search-agent capability
+at corpus scale)."""
+
+import asyncio
+
+import pytest
+
+from areal_tpu.agent.search_env import LocalSearchEnv
+
+CORPUS = [
+    "The capital of France is Paris, a major European city.",
+    "Mount Everest is the highest mountain above sea level.",
+    "The Pacific Ocean is the largest ocean on Earth.",
+    "Paris hosted the Summer Olympics in 1900, 1924 and 2024.",
+]
+
+
+def test_search_ranking_and_misses():
+    env = LocalSearchEnv(CORPUS, answer="Paris")
+    hits = env.search("capital of France", k=2)
+    assert hits and "Paris" in hits[0]
+    assert env.search("quantum chromodynamics") == []
+    assert env.n_searches == 2
+
+    # both Paris passages rank above unrelated ones
+    hits = env.search("Paris", k=4)
+    assert all("Paris" in h for h in hits[:2])
+
+
+def test_tool_surface():
+    async def go():
+        async with LocalSearchEnv(CORPUS, answer="Paris") as env:
+            names = [t["name"] for t in env.list_tools()]
+            assert names == ["search", "verify_answer"]
+            hits, r, done = await env.aexecute_tool(
+                "search", {"query": "highest mountain"}
+            )
+            assert not done and r == 0.0 and "Everest" in hits[0]
+            _, reward, done = await env.aexecute_tool(
+                "verify_answer",
+                {"completion": "The answer is \\boxed{Paris}"},
+            )
+            assert done and reward == 1.0
+            _, reward, _ = await env.aexecute_tool(
+                "verify_answer", {"completion": "\\boxed{London}"}
+            )
+            assert reward == 0.0
+
+    asyncio.run(go())

@@ -1,0 +1,151 @@
+"""TIR agent: code blocks execute in the sandbox mid-rollout, tool output
+tokens are injected untrained, and generation continues with results in
+context (reference: examples/tir)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.agent import TIRMathAgent, make_agent
+from areal_tpu.agent.math_env import MathVerifyEnv
+from areal_tpu.agent.tir_agent import find_first_block
+from areal_tpu.api.config import GenerationHyperparameters
+
+
+class _Tok:
+    def encode(self, text, add_special_tokens=False):
+        return [ord(c) % 256 for c in text]
+
+    def decode(self, tokens):
+        return "".join(chr(t) for t in tokens)
+
+    def apply_chat_template(self, messages, **kw):
+        return self.encode("".join(m["content"] for m in messages))
+
+
+class _ScriptedEngine:
+    def __init__(self, replies):
+        self.replies = list(replies)
+        self.calls = 0
+        self.prompts = []
+
+    async def agenerate(self, req):
+        self.prompts.append(_Tok().decode(req.input_ids))
+        text = self.replies[min(self.calls, len(self.replies) - 1)]
+        self.calls += 1
+        out = [ord(c) % 256 for c in text]
+
+        class R:
+            input_tokens = list(req.input_ids)
+            output_tokens = out
+            output_logprobs = [-0.2] * len(out)
+            output_versions = [3] * len(out)
+            input_len = len(req.input_ids)
+            output_len = len(out)
+            stop_reason = "stop"
+
+        return R()
+
+
+def test_find_first_block():
+    code, end = find_first_block("think ```python\nprint(1)\n``` more")
+    assert code == "print(1)\n"
+    assert end == len("think ```python\nprint(1)\n```")
+    assert find_first_block("no code here") == (None, None)
+
+
+def _run(agent, engine, env, data):
+    async def go():
+        if env is not None:
+            async with env:
+                return await agent.collect_trajectory(engine, env, data)
+        return await agent.collect_trajectory(engine, None, data)
+
+    return asyncio.run(go())
+
+
+def test_tool_loop_executes_and_injects_output():
+    # turn 1 emits a code block (plus overshoot to be discarded);
+    # turn 2 reads the tool result and answers
+    replies = [
+        "compute: ```python\nprint(6*7)\n``` I guess 41",
+        " so the answer is \\boxed{42}",
+    ]
+    engine = _ScriptedEngine(replies)
+    agent = TIRMathAgent(
+        GenerationHyperparameters(max_new_tokens=512), tokenizer=_Tok()
+    )
+    env = MathVerifyEnv(answer="42")
+    (traj,) = _run(agent, engine, env, {"messages": [{"role": "user", "content": "6*7?"}]})
+
+    assert engine.calls == 2
+    # the second prompt contains the tool's stdout, not the overshoot
+    assert "```output\n42\n```" in engine.prompts[1]
+    assert "I guess 41" not in engine.prompts[1]
+
+    full = _Tok().decode(list(traj["input_ids"]))
+    assert "\\boxed{42}" in full
+    assert traj["rewards"] == 1.0
+
+    # injected tool tokens are loss-masked and carry logprob 0
+    text_after_prompt = full[len("6*7?"):]
+    lm = traj["loss_mask"][len("6*7?"):]
+    lp = traj["logprobs"][len("6*7?"):]
+    out_start = text_after_prompt.index("```output")
+    out_end = text_after_prompt.index("```\n", out_start + 10) + 4
+    assert lm[out_start:out_end].sum() == 0
+    assert np.abs(lp[out_start:out_end]).sum() == 0
+    # sampled tokens are trained
+    assert lm[:out_start].sum() > 0
+    assert traj["versions"][0] == -1  # prompt tokens: no version
+
+
+def test_no_code_block_single_shot():
+    engine = _ScriptedEngine(["the answer is \\boxed{9}"])
+    agent = TIRMathAgent(
+        GenerationHyperparameters(max_new_tokens=64), tokenizer=_Tok()
+    )
+    env = MathVerifyEnv(answer="9")
+    (traj,) = _run(agent, engine, env, {"messages": [{"role": "user", "content": "3*3?"}]})
+    assert engine.calls == 1
+    assert traj["rewards"] == 1.0
+    assert traj["loss_mask"][len("3*3?"):].sum() == len("the answer is \\boxed{9}")
+
+
+def test_tool_call_cap():
+    # the model emits a code block every turn; the loop must stop at the cap
+    engine = _ScriptedEngine(["```python\nprint(1)\n```"] * 10)
+    agent = TIRMathAgent(
+        GenerationHyperparameters(max_new_tokens=4096),
+        tokenizer=_Tok(),
+        max_tool_calls=2,
+    )
+    (traj,) = _run(agent, engine, None, {"messages": [{"role": "user", "content": "q"}]})
+    assert engine.calls == 3  # 2 tool rounds + the final continuation
+    full = _Tok().decode(list(traj["input_ids"]))
+    assert full.count("```output") == 2
+
+
+def test_sandbox_error_feeds_back():
+    replies = [
+        "```python\nraise ValueError('nope')\n```",
+        "\\boxed{0}",
+    ]
+    engine = _ScriptedEngine(replies)
+    agent = TIRMathAgent(
+        GenerationHyperparameters(max_new_tokens=512), tokenizer=_Tok()
+    )
+    (traj,) = _run(agent, engine, None, {"messages": [{"role": "user", "content": "q"}]})
+    # the error marker reached the model's second prompt
+    assert "```output" in engine.prompts[1]
+    assert "exit" in engine.prompts[1] or "error" in engine.prompts[1]
+
+
+def test_registry():
+    agent = make_agent(
+        "tir-math",
+        gconfig=GenerationHyperparameters(max_new_tokens=8),
+        tokenizer=_Tok(),
+    )
+    assert isinstance(agent, TIRMathAgent)
