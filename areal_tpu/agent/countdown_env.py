@@ -69,7 +69,14 @@ def verify_countdown(
         tree = ast.parse(expr, mode="eval")
         used: List[int] = []
         value = _safe_eval(tree, used)
-    except (SyntaxError, ValueError, ZeroDivisionError, RecursionError):
+    except (
+        SyntaxError,
+        ValueError,
+        ZeroDivisionError,
+        RecursionError,
+        OverflowError,  # e.g. a 400-digit literal: float() overflows
+        MemoryError,
+    ):
         return 0.0
     pool = list(numbers)
     for n in used:

@@ -25,7 +25,11 @@ from areal_tpu.agent.api import Agent, register_agent
 from areal_tpu.api.config import GenerationHyperparameters
 from areal_tpu.api.io_struct import ModelRequest
 
-_BLOCK_RE = re.compile(r"```python\s*\n(.*?)```", re.DOTALL)
+# ```python / ```py only — deliberately narrower than code_verifier's
+# extract_code (which also takes bare fences): the TIR transcript contains
+# ```output blocks the agent itself injected, and a bare-fence match would
+# "execute" those
+_BLOCK_RE = re.compile(r"```(?:python|py)\s*\n(.*?)```", re.DOTALL)
 
 
 def find_first_block(text: str):

@@ -89,6 +89,7 @@ def concat_padded_tensors(
     out: Dict[str, np.ndarray] = {}
     for k in keys:
         parts = []
+        img_offset = 0
         for d in dicts:
             arr = d[k]
             B, L = d["attention_mask"].shape
@@ -96,6 +97,14 @@ def concat_padded_tensors(
                 pad_width = [(0, 0), (0, max_len - L)] + [(0, 0)] * (arr.ndim - 2)
                 fill = False if arr.dtype == np.bool_ else pad_value
                 arr = np.pad(arr, pad_width, constant_values=fill)
+            if k == "patch_img_ids":
+                # image ids must stay unique across episodes: patch order
+                # defines the embedding<->placeholder mapping and shared ids
+                # would merge attention across different images.  -1 is the
+                # pad sentinel and never advances the offset.
+                arr = np.where(arr >= 0, arr + img_offset, arr)
+                if arr.size and int(arr.max()) >= 0:
+                    img_offset = max(img_offset, int(arr.max()) + 1)
             parts.append(arr)
         out[k] = np.concatenate(parts, axis=0)
     return out

@@ -98,18 +98,26 @@ class RLVRWorkflow(RolloutWorkflow):
             logprobs = [0.0] * resp.input_len + resp.output_logprobs
             loss_mask = [0] * resp.input_len + [1] * resp.output_len
             versions = [-1] * resp.input_len + resp.output_versions
-            results.append(
-                dict(
-                    input_ids=np.array(seq, dtype=np.int32),
-                    logprobs=np.array(logprobs, dtype=np.float32),
-                    loss_mask=np.array(loss_mask, dtype=np.int32),
-                    versions=np.array(versions, dtype=np.int32),
-                    rewards=np.float32(reward),
-                )
+            result = dict(
+                input_ids=np.array(seq, dtype=np.int32),
+                logprobs=np.array(logprobs, dtype=np.float32),
+                loss_mask=np.array(loss_mask, dtype=np.int32),
+                versions=np.array(versions, dtype=np.int32),
+                rewards=np.float32(reward),
             )
+            results.append(self._augment_result(result, data, resp))
             if self.dump_dir:
                 self._dump(data, prompt_str, completion_str, reward, resp)
-        return pad_sequences_to_tensors(results)
+        batch = pad_sequences_to_tensors(results)
+        return self._augment_batch(batch, data, len(results))
+
+    def _augment_result(self, result, data, resp):
+        """Hook: subclasses add per-sample keys (vision: mrope positions)."""
+        return result
+
+    def _augment_batch(self, batch, data, n_samples: int):
+        """Hook: subclasses add batch-level payloads (vision: pixels)."""
+        return batch
 
     def _dump(self, data, prompt_str, completion_str, reward, resp):
         qid = str(data.get("query_id", data.get("qid", "unknown")))

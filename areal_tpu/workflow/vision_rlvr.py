@@ -33,6 +33,8 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         enable_thinking: bool = False,
         rollout_stat_scope: str = "rollout",
         dump_dir: Optional[str] = None,
+        image_token_id: Optional[int] = None,
+        spatial_merge_size: int = 2,
     ):
         super().__init__(
             reward_fn,
@@ -43,6 +45,14 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             dump_dir=dump_dir,
         )
         self.processor = processor
+        # needed to build trainer-side mrope positions; fall back to the
+        # processor's advertised id when not given explicitly
+        self.image_token_id = (
+            image_token_id
+            if image_token_id is not None
+            else getattr(processor, "image_token_id", None)
+        )
+        self.spatial_merge_size = spatial_merge_size
 
     def _build_request(self, data: Dict[str, Any]) -> ModelRequest:
         images = load_images(data["images"]) if "images" in data else None
@@ -61,10 +71,14 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             ids = processed["input_ids"]
             input_ids = list(ids[0] if hasattr(ids[0], "__len__") else ids)
             # the processor's patchified pixels feed the native VLM server
-            # directly (gen/server.py pixel_values_b64 wire field)
+            # directly (gen/server.py pixel_values_b64 wire field); stash
+            # them on the episode data so trajectory augmentation reuses
+            # them for the train batch
             if pixel_values is None and "pixel_values" in processed:
                 pixel_values = processed["pixel_values"]
                 image_grid_thw = processed.get("image_grid_thw")
+                data["pixel_values"] = pixel_values
+                data["image_grid_thw"] = image_grid_thw
         return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=input_ids,
@@ -77,4 +91,70 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         )
 
     def _reward_kwargs(self, data: Dict[str, Any]) -> Dict[str, Any]:
-        return {k: v for k, v in data.items() if k != "images"}
+        return {
+            k: v
+            for k, v in data.items()
+            if k not in ("images", "pixel_values", "image_grid_thw")
+        }
+
+    # --- trainer payload: mrope positions + pixels -----------------------
+
+    def _augment_result(self, result, data, resp):
+        """Per-sample (t, h, w) rope positions [T, 3]: the prompt part from
+        the image grids, generated tokens continuing linearly past the
+        compressed extent (models/vision.py mrope scheme)."""
+        if "pixel_values" not in data:
+            # image_data-only mode (external multimodal backend serves the
+            # images; the trainer sees text rows).  Datasets must not MIX
+            # pixel and non-pixel episodes — the executor's concat rejects
+            # inconsistent keys loudly if they do.
+            return result
+        if self.image_token_id is None:
+            raise ValueError(
+                "VisionRLVRWorkflow needs image_token_id (pass it or use a "
+                "processor that exposes one) — training without mrope while "
+                "serving decodes with it would silently mismatch positions"
+            )
+        import numpy as np
+
+        from areal_tpu.models.vision import mrope_position_ids
+
+        mpos = data.get("_mrope_prompt_cache")
+        if mpos is None:
+            # identical for every sample of the episode (same prompt/grids)
+            grid = np.asarray(data["image_grid_thw"], np.int64).reshape(-1, 3)
+            prompt = np.asarray(resp.input_tokens, np.int64)
+            mpos = mrope_position_ids(
+                prompt, grid, self.image_token_id,
+                spatial_merge_size=self.spatial_merge_size,
+            )  # [3, P]
+            data["_mrope_prompt_cache"] = mpos
+        T = len(result["input_ids"])
+        P = mpos.shape[1]
+        full = np.zeros((3, T), np.int32)
+        full[:, :P] = mpos
+        nxt = int(mpos.max()) + 1
+        tail = np.arange(T - P, dtype=np.int32) + nxt
+        full[:, P:] = tail[None, :]
+        result["mrope_positions"] = full.T  # [T, 3] for per-token padding
+        return result
+
+    def _augment_batch(self, batch, data, n_samples: int):
+        """Batch-level pixels: every sample row shares the episode's
+        image(s), so patches repeat per row — in row order, with per-row
+        image ids (concat across episodes renumbers them globally)."""
+        data.pop("_mrope_prompt_cache", None)  # episode-scoped
+        if "pixel_values" not in data:
+            return batch  # image_data-only mode: text-style training rows
+        import numpy as np
+
+        pv = np.asarray(data["pixel_values"], np.float32)
+        grid = np.asarray(data["image_grid_thw"], np.int64).reshape(-1, 3)
+        n_img = grid.shape[0]
+        per_image = (grid[:, 0] * grid[:, 1] * grid[:, 2]).astype(np.int64)
+        ids_one = np.repeat(np.arange(n_img), per_image)
+        batch["pixel_values"] = np.tile(pv, (n_samples, 1))
+        batch["patch_img_ids"] = np.concatenate(
+            [ids_one + r * n_img for r in range(n_samples)]
+        ).astype(np.int32)
+        return batch

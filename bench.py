@@ -124,11 +124,15 @@ def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2, group_
             actor.ppo_update(batch)
             jax.block_until_ready(actor.params)
 
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        actor.ppo_update(batch)
-    jax.block_until_ready(actor.params)
-    dt = (time.perf_counter() - t0) / MEASURE_STEPS
+    # two measurement windows, best wins: the tunneled chip's host-side
+    # jitter (network hops per dispatch) biases single windows downward
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            actor.ppo_update(batch)
+        jax.block_until_ready(actor.params)
+        dt = min(dt, (time.perf_counter() - t0) / MEASURE_STEPS)
 
     tok_per_sec = tokens_per_step / dt
     result = {
