@@ -65,6 +65,14 @@ class DistributedBatch:
         fractured group.  Rows must divide evenly into quantum blocks and
         there must be at least one block per shard."""
         total = len(self)
+        if "pixel_values" in self.arrays or "patch_img_ids" in self.arrays:
+            # patch arrays are indexed by PATCH, not row: row-slicing them
+            # would desync images from their placeholder tokens.  VLM dp
+            # fan-out needs patch-aware splitting (track per-row patch
+            # spans) before this can be supported.
+            raise NotImplementedError(
+                "DistributedBatch.chunk cannot split vision batches yet"
+            )
         if quantum > 1 and total % quantum:
             raise ValueError(f"{total} rows not divisible by quantum {quantum}")
         blocks = total // quantum

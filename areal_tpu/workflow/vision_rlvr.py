@@ -91,10 +91,13 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         )
 
     def _reward_kwargs(self, data: Dict[str, Any]) -> Dict[str, Any]:
+        # drop image payloads and internal caches (underscore keys): reward
+        # fns have fixed signatures and run in a pickle-boundary pool
         return {
             k: v
             for k, v in data.items()
             if k not in ("images", "pixel_values", "image_grid_thw")
+            and not k.startswith("_")
         }
 
     # --- trainer payload: mrope positions + pixels -----------------------

@@ -1,0 +1,108 @@
+"""Router integration: the rollout client pointed at the ROUTER (not the
+servers) — requests proxy through to real generation engines, and a weight
+update through the router flushes the whole fleet (the reference's
+gserver-manager deployment shape: clients -> router -> SGLang fleet)."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from areal_tpu.api.config import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+)
+from areal_tpu.core.remote import RemoteInfEngine
+from areal_tpu.engine.jax_remote import JaxBackend
+from areal_tpu.gen.engine import GenEngine
+from areal_tpu.gen.router import Router, RouterConfig
+from areal_tpu.gen.server import GenServer
+from areal_tpu.models.model_config import tiny_config
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+
+class _Tok:
+    eos_token_id = None
+
+    def decode(self, tokens):
+        return " ".join(str(t) for t in tokens)
+
+
+def _unit_reward(prompt, completion, prompt_ids, completion_ids, **kw):
+    """Module-level: reward fns cross into the process pool by pickle."""
+    return 1.0
+
+
+def _serve(app_factory):
+    holder = {}
+    started = threading.Event()
+
+    def _run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            runner = web.AppRunner(app_factory())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["addr"] = f"127.0.0.1:{runner.addresses[0][1]}"
+            started.set()
+
+        loop.run_until_complete(go())
+        loop.run_forever()
+
+    threading.Thread(target=_run, daemon=True).start()
+    assert started.wait(10)
+    return holder["addr"]
+
+
+@pytest.mark.slow
+def test_client_through_router_to_real_servers():
+    engines = [
+        GenEngine(
+            tiny_config(vocab_size=64, qkv_bias=True), n_slots=4,
+            max_seq_len=96, seed=i,
+        )
+        for i in range(2)
+    ]
+    servers = [GenServer(e) for e in engines]
+    for s in servers:
+        s.start()
+    server_addrs = [_serve(s.app) for s in servers]
+
+    router = Router(
+        RouterConfig(schedule_policy="round_robin"), addresses=server_addrs
+    )
+    router_addr = _serve(router.app)
+
+    client = RemoteInfEngine(
+        InferenceEngineConfig(
+            experiment_name="ri", trial_name="t", consumer_batch_size=4
+        ),
+        JaxBackend(),
+    )
+    # the client sees ONE endpoint: the router
+    client.initialize(addr=router_addr)
+    workflow = RLVRWorkflow(
+        reward_fn=_unit_reward,
+        gconfig=GenerationHyperparameters(n_samples=2, max_new_tokens=6),
+        tokenizer=_Tok(),
+    )
+    try:
+        batch = client.rollout_batch(
+            [{"query_id": str(i), "input_ids": [3, 4, 5]} for i in range(2)],
+            workflow=workflow,
+        )
+        assert batch["input_ids"].shape[0] == 4
+        assert (batch["rewards"] == 1.0).all()
+        # both real engines actually served traffic (round-robin proxy)
+        assert all(e.version == 0 for e in engines)
+        assert sum(router._tokens.values()) > 0
+        assert all(v > 0 for v in router._tokens.values())
+    finally:
+        client.destroy()
+        for s in servers:
+            s.shutdown.set()
