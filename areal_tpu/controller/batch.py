@@ -65,13 +65,13 @@ class DistributedBatch:
         fractured group.  Rows must divide evenly into quantum blocks and
         there must be at least one block per shard."""
         total = len(self)
-        if "pixel_values" in self.arrays or "patch_img_ids" in self.arrays:
-            # patch arrays are indexed by PATCH, not row: row-slicing them
-            # would desync images from their placeholder tokens.  VLM dp
-            # fan-out needs patch-aware splitting (track per-row patch
-            # spans) before this can be supported.
-            raise NotImplementedError(
-                "DistributedBatch.chunk cannot split vision batches yet"
+        has_vision = "pixel_values" in self.arrays or "patch_img_ids" in self.arrays
+        if has_vision and "patches_per_row" not in self.arrays:
+            # patch arrays are indexed by PATCH, not row: without per-row
+            # patch spans (vision_rlvr emits "patches_per_row") slicing
+            # them would desync images from their placeholder tokens
+            raise ValueError(
+                "vision batches need 'patches_per_row' to be chunked"
             )
         if quantum > 1 and total % quantum:
             raise ValueError(f"{total} rows not divisible by quantum {quantum}")
@@ -82,9 +82,32 @@ class DistributedBatch:
                 f"into {n} shards"
             )
         bounds = (np.linspace(0, blocks, n + 1).astype(int)) * quantum
+        patch_keys = ("pixel_values", "patch_img_ids")
+        if has_vision:
+            patch_bounds = np.concatenate(
+                [[0], np.cumsum(self.arrays["patches_per_row"])]
+            )
+            for k in patch_keys:
+                if k in self.arrays and (
+                    self.arrays[k].shape[0] != int(patch_bounds[-1])
+                ):
+                    # spans must describe the patch arrays exactly, or the
+                    # slices silently pair wrong images with rows
+                    raise ValueError(
+                        f"patches_per_row sums to {int(patch_bounds[-1])} "
+                        f"but {k} has {self.arrays[k].shape[0]} patches"
+                    )
+        row_arrays = {
+            k: v for k, v in self.arrays.items() if k not in patch_keys
+        }
         out = []
         for lo, hi in zip(bounds[:-1], bounds[1:]):
-            shard = select_rows(self.arrays, list(range(lo, hi)))
+            shard = select_rows(row_arrays, list(range(lo, hi)))
+            if has_vision:
+                p_lo, p_hi = int(patch_bounds[lo]), int(patch_bounds[hi])
+                for k in patch_keys:
+                    if k in self.arrays:
+                        shard[k] = self.arrays[k][p_lo:p_hi]
             b = DistributedBatch(shard)
             b.meta = dict(self.meta)
             out.append(b)

@@ -160,4 +160,10 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         batch["patch_img_ids"] = np.concatenate(
             [ids_one + r * n_img for r in range(n_samples)]
         ).astype(np.int32)
+        # per-row patch counts: the metadata that lets row-wise splitters
+        # (controller dp fan-out, micro-batching) carve the patch arrays
+        # consistently with the rows
+        batch["patches_per_row"] = np.full(
+            n_samples, int(per_image.sum()), np.int64
+        )
         return batch

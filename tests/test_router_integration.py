@@ -60,7 +60,7 @@ def _serve(app_factory):
 
 
 @pytest.mark.slow
-def test_client_through_router_to_real_servers():
+def test_client_through_router_to_real_servers(tmp_path):
     engines = [
         GenEngine(
             tiny_config(vocab_size=64, qkv_bias=True), n_slots=4,
@@ -98,10 +98,40 @@ def test_client_through_router_to_real_servers():
         )
         assert batch["input_ids"].shape[0] == 4
         assert (batch["rewards"] == 1.0).all()
-        # both real engines actually served traffic (round-robin proxy)
-        assert all(e.version == 0 for e in engines)
-        assert sum(router._tokens.values()) > 0
+        # both real engines served traffic (round-robin proxy)
         assert all(v > 0 for v in router._tokens.values())
+
+        # a weight update THROUGH the router flushes every real engine:
+        # pause fleet-wide, load the checkpoint, resume, bump versions
+        import json
+        import urllib.request
+
+        import jax
+
+        from areal_tpu.models.hf import save_hf_checkpoint
+
+        host = jax.tree_util.tree_map(np.asarray, engines[0].params)
+        ckpt = tmp_path / "w"
+        save_hf_checkpoint(
+            host, engines[0].model_config, str(ckpt), save_dtype="float32"
+        )
+        req = urllib.request.Request(
+            f"http://{router_addr}/update_weights",
+            data=json.dumps({"path": str(ckpt), "version": 5}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            out = json.loads(resp.read())
+        assert out["version"] == 5
+        assert all(e.version == 5 for e in engines)  # whole fleet updated
+        assert all(not s.paused.is_set() for s in servers)  # and resumed
+
+        # generation still works on the new weights
+        batch2 = client.rollout_batch(
+            [{"query_id": "post", "input_ids": [9, 10]}], workflow=workflow
+        )
+        assert batch2["input_ids"].shape[0] == 2
+        assert (batch2["versions"][batch2["loss_mask"] > 0] == 5).all()
     finally:
         client.destroy()
         for s in servers:

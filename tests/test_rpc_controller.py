@@ -136,6 +136,44 @@ def test_distributed_batch_roundtrip_chunk_union():
         back.chunk(2, quantum=4)  # 6 % 4 != 0
 
 
+def test_distributed_batch_vision_chunk():
+    """Patch arrays split by per-row spans, keeping each row's images with
+    its tokens (VLM dp fan-out)."""
+    rng = np.random.default_rng(1)
+    B, L = 4, 8
+    patches_per_row = np.array([4, 8, 4, 8], np.int64)
+    N = int(patches_per_row.sum())
+    pv = rng.normal(size=(N, 6)).astype(np.float32)
+    img_ids = np.repeat(np.arange(B), patches_per_row).astype(np.int32)
+    b = DistributedBatch(
+        {
+            "input_ids": rng.integers(0, 64, (B, L)).astype(np.int32),
+            "attention_mask": np.ones((B, L), bool),
+            "pixel_values": pv,
+            "patch_img_ids": img_ids,
+            "patches_per_row": patches_per_row,
+        }
+    )
+    shards = b.chunk(2)
+    assert [len(s) for s in shards] == [2, 2]
+    assert shards[0]["pixel_values"].shape[0] == 12  # rows 0+1: 4+8
+    assert shards[1]["pixel_values"].shape[0] == 12  # rows 2+3
+    np.testing.assert_array_equal(shards[0]["pixel_values"], pv[:12])
+    np.testing.assert_array_equal(shards[1]["pixel_values"], pv[12:])
+    np.testing.assert_array_equal(shards[1]["patch_img_ids"], img_ids[12:])
+
+    # without the span metadata, vision chunking refuses loudly
+    no_spans = DistributedBatch(
+        {
+            "input_ids": np.zeros((2, 4), np.int32),
+            "attention_mask": np.ones((2, 4), bool),
+            "pixel_values": pv[:8],
+        }
+    )
+    with pytest.raises(ValueError, match="patches_per_row"):
+        no_spans.chunk(2)
+
+
 def test_rpc_engine_roundtrip():
     actor = _actor()
     h = ServerHarness(actor)
