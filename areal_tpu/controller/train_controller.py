@@ -79,7 +79,12 @@ class TrainController:
         return np.concatenate(parts, axis=0)
 
     def compute_advantages(self, batch: Dict[str, Any]) -> Dict[str, Any]:
-        parts, _ = self._fan("compute_advantages", batch, return_batch=True)
+        # advantage math is host-side reward/logp arithmetic: the pixel
+        # tensors are dead weight on this RPC — strip them from the fan-out
+        # so the echoed batches don't double the largest transfer
+        heavy = ("pixel_values", "patch_img_ids", "patches_per_row")
+        view = {k: v for k, v in batch.items() if k not in heavy}
+        parts, _ = self._fan("compute_advantages", view, return_batch=True)
         merged = DistributedBatch.concat(
             [DistributedBatch(p) for p in parts]
         ).to_dict()

@@ -47,12 +47,6 @@ class JaxVLMEngine(JaxTrainEngine):
         if model_config.image_token_id is None:
             raise ValueError("model_config.image_token_id is required")
         super().__init__(config, model_config)
-        if max(1, config.mb_spec.n_mbs) != 1:
-            raise NotImplementedError(
-                "VLM engine v1 runs a single micro-batch per step (pixel "
-                "tensors cannot be split across an mb scan); raise "
-                "batch-level parallelism instead"
-            )
 
     # ------------------------------------------------------------------
 
@@ -84,6 +78,19 @@ class JaxVLMEngine(JaxTrainEngine):
 
     # ------------------------------------------------------------------
 
+    def _row_mult(self) -> int:
+        """Rows (and patch groups) must divide over the data-parallel mesh
+        axes — the ONE definition both _prepare_rows and _stack_mbs use."""
+        return (
+            self.mesh.shape["dp"]
+            * self.mesh.shape["fsdp"]
+            * self.mesh.shape.get("ep", 1)
+        )
+
+    def _patch_quantum(self) -> int:
+        """Patch-count granularity: merge windows (m2) times the dp axes."""
+        return self.model_config.vision.spatial_merge_size ** 2 * self._row_mult()
+
     def _prepare_rows(
         self, batch: Dict[str, np.ndarray], n_mbs: int
     ) -> Tuple[RowPackedBatch, Dict[str, np.ndarray], int]:
@@ -92,11 +99,7 @@ class JaxVLMEngine(JaxTrainEngine):
         filler patches to shard divisibility."""
         mask = batch["attention_mask"].astype(bool)
         B, L = mask.shape
-        mult = n_mbs * (
-            self.mesh.shape["dp"]
-            * self.mesh.shape["fsdp"]
-            * self.mesh.shape.get("ep", 1)
-        )
+        mult = n_mbs * self._row_mult()
         R = ((B + mult - 1) // mult) * mult
 
         data: Dict[str, np.ndarray] = {}
@@ -121,8 +124,7 @@ class JaxVLMEngine(JaxTrainEngine):
         # (their merged embeddings land past every real placeholder index)
         pv = batch["pixel_values"]
         ids = batch["patch_img_ids"]
-        m2 = self.model_config.vision.spatial_merge_size ** 2
-        quantum = mult * m2
+        quantum = n_mbs * self._patch_quantum()
         N = ((pv.shape[0] + quantum - 1) // quantum) * quantum
         pad_pv = np.zeros((N, pv.shape[1]), pv.dtype)
         pad_pv[: pv.shape[0]] = pv
@@ -130,6 +132,22 @@ class JaxVLMEngine(JaxTrainEngine):
         pad_ids[: ids.shape[0]] = ids
         data["pixel_values"] = pad_pv
         data["patch_img_ids"] = pad_ids
+        # per-row patch spans: the mb splitter needs them to carve patch
+        # arrays along row-group boundaries
+        if "patches_per_row" in batch:
+            spans = np.zeros(R, np.int64)
+            spans[:B] = np.asarray(batch["patches_per_row"], np.int64)
+            if int(spans.sum()) != pv.shape[0]:
+                raise ValueError(
+                    f"patches_per_row sums to {int(spans.sum())} but "
+                    f"pixel_values has {pv.shape[0]} patches"
+                )
+            data["patches_per_row"] = spans
+        elif n_mbs > 1:
+            raise ValueError(
+                "micro-batching a vision batch needs 'patches_per_row' "
+                "(emitted by VisionRLVRWorkflow) to split patch arrays"
+            )
 
         placements = [[(i, L)] for i in range(B)] + [[] for _ in range(R - B)]
         return (
@@ -138,10 +156,46 @@ class JaxVLMEngine(JaxTrainEngine):
             L,
         )
 
+    def _stack_mbs(self, data, n_mbs: int):
+        """[R, ...] -> [n_mbs, R/n_mbs, ...] for token arrays; patch arrays
+        are carved along row-group boundaries via the per-row spans and
+        re-padded to a common per-mb patch count (uniform shapes for the
+        grad-accumulation scan)."""
+        vision = {
+            k: data.pop(k)
+            for k in (*VISION_KEYS, "patches_per_row")
+            if k in data
+        }
+        out = super()._stack_mbs(data, n_mbs)
+        pv, ids = vision["pixel_values"], vision["patch_img_ids"]
+        if n_mbs == 1:
+            out["pixel_values"] = pv[None]
+            out["patch_img_ids"] = ids[None]
+            return out
+        spans = vision["patches_per_row"]
+        R = spans.shape[0]
+        rpm = R // n_mbs
+        bounds = np.concatenate([[0], np.cumsum(spans)]).astype(np.int64)
+        lo = [int(bounds[i * rpm]) for i in range(n_mbs)]
+        hi = [int(bounds[(i + 1) * rpm]) for i in range(n_mbs)]
+        dp_mult = self._patch_quantum()
+        pmax = max(max(h - l for l, h in zip(lo, hi)), dp_mult)
+        pmax = ((pmax + dp_mult - 1) // dp_mult) * dp_mult
+        pv_mb = np.zeros((n_mbs, pmax, pv.shape[1]), pv.dtype)
+        ids_mb = np.full((n_mbs, pmax), -1, np.int32)
+        for i, (l, h) in enumerate(zip(lo, hi)):
+            pv_mb[i, : h - l] = pv[l:h]
+            ids_mb[i, : h - l] = ids[l:h]
+        out["pixel_values"] = pv_mb
+        out["patch_img_ids"] = ids_mb
+        return out
+
     def _device_batch(self, data, stacked: bool):
         """Per-key sharding: token arrays use the standard batch spec;
         patch arrays shard the patch dim over the row axes (rank-1
-        patch_img_ids cannot take the 2-axis token spec)."""
+        patch_img_ids cannot take the 2-axis token spec).  The host-side
+        span metadata never ships to devices."""
+        data = {k: v for k, v in data.items() if k != "patches_per_row"}
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
@@ -209,7 +263,9 @@ class VLMPPOActor:
         self._ppo.compute_advantages(batch)
 
     def ppo_update(self, batch):
-        keys = self._ppo.LOSS_KEYS + VISION_KEYS + ("mrope_positions",)
+        keys = self._ppo.LOSS_KEYS + VISION_KEYS + (
+            "mrope_positions", "patches_per_row",
+        )
         view = {k: batch[k] for k in keys if k in batch}
         # loss construction, stat normalisation, and tracker commit are the
         # base actor's — one source, no drift

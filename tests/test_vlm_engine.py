@@ -118,6 +118,46 @@ def test_vlm_grpo_update_single_device():
         actor.destroy()
 
 
+def test_vlm_grpo_update_microbatched():
+    """n_mbs=2 grad accumulation: patch arrays carve along row groups via
+    patches_per_row and the scan sees uniform per-mb shapes."""
+    cfg = _cfg()
+    cfg.mb_spec = MicroBatchSpec(n_mbs=2)
+    actor = JaxVLMPPOActor(cfg, model_config=_model_cfg())
+    actor.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    try:
+        rng = np.random.default_rng(7)
+        batch = _vlm_batch(rng, B=4)
+        batch["patches_per_row"] = np.full(4, 16, np.int64)
+        batch["prox_logp"] = actor.compute_logp(batch)
+
+        # parity BEFORE any update: same init, logp must not depend on the
+        # engine's micro-batch setting
+        cfg1 = _cfg()
+        actor1 = JaxVLMPPOActor(cfg1, model_config=_model_cfg())
+        actor1.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+        try:
+            l1 = actor1.compute_logp(batch)
+            np.testing.assert_allclose(
+                l1, batch["prox_logp"], rtol=1e-5, atol=1e-5
+            )
+        finally:
+            actor1.destroy()
+
+        actor.compute_advantages(batch)
+        stats = actor.ppo_update(batch)
+        assert np.isfinite(stats[-1]["loss"])
+
+        # micro-batching without spans is refused loudly
+        batch2 = _vlm_batch(rng, B=4)
+        batch2["prox_logp"] = batch2["logprobs"].copy()
+        actor.compute_advantages(batch2)
+        with pytest.raises(ValueError, match="patches_per_row"):
+            actor.ppo_update(batch2)
+    finally:
+        actor.destroy()
+
+
 def test_vlm_grpo_update_sharded_mesh():
     """dp2 x tp2 on the virtual CPU mesh: filler rows/patches pad shapes to
     shard divisibility and the update still runs."""
