@@ -56,10 +56,39 @@ class AgentWorkflow(RolloutWorkflow):
     def __init__(self, agent: Agent, env_factory: Optional[Callable] = None):
         self.agent = agent
         self.env_factory = env_factory
+        self._factory_takes_data: Optional[bool] = None
+
+    def _make_env(self, data: Dict[str, Any]):
+        """Factories may take the episode's data (per-episode ground truth,
+        e.g. `lambda data: MathVerifyEnv(answer=data['answer'])`) or
+        nothing.  Only REQUIRED positional parameters make a factory
+        data-taking — `partial(Env, answer='7')` or `lambda seed=0: Env()`
+        must keep their zero-arg call."""
+        if self._factory_takes_data is None:
+            import inspect
+
+            try:
+                sig = inspect.signature(self.env_factory)
+                required = [
+                    p
+                    for p in sig.parameters.values()
+                    if p.default is inspect.Parameter.empty
+                    and p.kind
+                    in (
+                        inspect.Parameter.POSITIONAL_ONLY,
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    )
+                ]
+                self._factory_takes_data = len(required) >= 1
+            except (TypeError, ValueError):
+                self._factory_takes_data = False
+        if self._factory_takes_data:
+            return self.env_factory(data)
+        return self.env_factory()
 
     async def arun_episode(self, engine, data: Dict[str, Any]):
         if self.env_factory is not None:
-            async with self.env_factory() as env:
+            async with self._make_env(data) as env:
                 trajs = await self.agent.collect_trajectory(engine, env, data)
         else:
             trajs = await self.agent.collect_trajectory(engine, None, data)

@@ -81,8 +81,9 @@ class DistributedBatch:
                 f"cannot chunk {total} rows ({blocks} blocks of {quantum}) "
                 f"into {n} shards"
             )
+        from areal_tpu.utils.data import VISION_PATCH_KEYS as patch_keys
+
         bounds = (np.linspace(0, blocks, n + 1).astype(int)) * quantum
-        patch_keys = ("pixel_values", "patch_img_ids")
         if has_vision:
             patch_bounds = np.concatenate(
                 [[0], np.cumsum(self.arrays["patches_per_row"])]

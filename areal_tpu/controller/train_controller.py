@@ -82,8 +82,9 @@ class TrainController:
         # advantage math is host-side reward/logp arithmetic: the pixel
         # tensors are dead weight on this RPC — strip them from the fan-out
         # so the echoed batches don't double the largest transfer
-        heavy = ("pixel_values", "patch_img_ids", "patches_per_row")
-        view = {k: v for k, v in batch.items() if k not in heavy}
+        from areal_tpu.utils.data import VISION_BATCH_KEYS
+
+        view = {k: v for k, v in batch.items() if k not in VISION_BATCH_KEYS}
         parts, _ = self._fan("compute_advantages", view, return_batch=True)
         merged = DistributedBatch.concat(
             [DistributedBatch(p) for p in parts]

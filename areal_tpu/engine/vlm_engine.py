@@ -31,9 +31,9 @@ from areal_tpu.api.config import TrainEngineConfig
 from areal_tpu.engine.jax_train import JaxTrainEngine
 from areal_tpu.models.model_config import TransformerConfig
 from areal_tpu.models.vision import forward_vlm_lm, init_vision_params
-from areal_tpu.utils.data import RowPackedBatch
+from areal_tpu.utils.data import RowPackedBatch, VISION_PATCH_KEYS
 
-VISION_KEYS = ("pixel_values", "patch_img_ids")
+VISION_KEYS = VISION_PATCH_KEYS
 
 
 class JaxVLMEngine(JaxTrainEngine):

@@ -28,6 +28,13 @@ def _is_per_token(key: str, arr: np.ndarray, batch: int, seqlen: int) -> bool:
     return arr.ndim >= 2 and arr.shape[0] == batch and arr.shape[1] == seqlen
 
 
+# vision batch keys indexed by PATCH (not row) plus the per-row span
+# metadata that lets row-wise splitters carve them — the ONE list the
+# controller, the batch container, and the VLM engine all share
+VISION_PATCH_KEYS = ("pixel_values", "patch_img_ids")
+VISION_BATCH_KEYS = VISION_PATCH_KEYS + ("patches_per_row",)
+
+
 # ---------------------------------------------------------------------------
 # Padded representation
 # ---------------------------------------------------------------------------

@@ -121,9 +121,20 @@ def main(argv):
             max_turns=config.max_turns,
             turn_discount=config.turn_discount,
         )
+    elif config.workflow == "tir":
+        # tool-integrated reasoning: sandboxed ```python execution
+        # mid-rollout (agent/tir_agent.py; reference: examples/tir)
+        from areal_tpu.agent import AgentWorkflow, TIRMathAgent
+        from areal_tpu.agent.math_env import MathVerifyEnv
+
+        workflow = AgentWorkflow(
+            TIRMathAgent(config.gconfig, tokenizer=tokenizer),
+            env_factory=lambda data: MathVerifyEnv(answer=data["answer"]),
+        )
     elif config.workflow != "rlvr":
         raise ValueError(
-            f"unknown workflow {config.workflow!r}; use 'rlvr' or 'multi_turn'"
+            f"unknown workflow {config.workflow!r}; use 'rlvr', "
+            "'multi_turn', or 'tir'"
         )
     else:
         workflow = RLVRWorkflow(

@@ -1,0 +1,169 @@
+"""TIR entry-point smoke: workflow=tir runs the full launcher loop
+(reference: examples/tir), and AgentWorkflow passes episode data to
+data-aware env factories."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.fixtures import make_gsm8k_jsonl, make_tiny_ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_env_factory_receives_episode_data():
+    from areal_tpu.agent import AgentWorkflow, MathSingleStepAgent
+    from areal_tpu.agent.math_env import MathVerifyEnv
+    from areal_tpu.api.config import GenerationHyperparameters
+
+    seen = []
+
+    def factory(data):
+        seen.append(data["answer"])
+        return MathVerifyEnv(answer=data["answer"])
+
+    class _Tok:
+        def encode(self, t, add_special_tokens=False):
+            return [ord(c) % 256 for c in t]
+
+        def decode(self, t):
+            return "".join(chr(x) for x in t)
+
+        def apply_chat_template(self, m, **kw):
+            return self.encode("".join(x["content"] for x in m))
+
+    class _Eng:
+        async def agenerate(self, req):
+            out = [ord(c) for c in "\\boxed{5}"]
+
+            class R:
+                input_tokens = list(req.input_ids)
+                output_tokens = out
+                output_logprobs = [-0.1] * len(out)
+                output_versions = [0] * len(out)
+                input_len = len(req.input_ids)
+                output_len = len(out)
+                stop_reason = "stop"
+
+            return R()
+
+    wf = AgentWorkflow(
+        MathSingleStepAgent(
+            GenerationHyperparameters(n_samples=1, max_new_tokens=16),
+            tokenizer=_Tok(),
+        ),
+        env_factory=factory,
+    )
+    batch = asyncio.run(
+        wf.arun_episode(_Eng(), {"messages": [{"role": "user", "content": "q"}],
+                                 "answer": "5"})
+    )
+    assert seen == ["5"]
+    assert (batch["rewards"] == 1.0).all()
+
+    # zero-arg factories keep working
+    wf2 = AgentWorkflow(
+        MathSingleStepAgent(
+            GenerationHyperparameters(n_samples=1, max_new_tokens=16),
+            tokenizer=_Tok(),
+        ),
+        env_factory=lambda: MathVerifyEnv(answer="5"),
+    )
+    batch2 = asyncio.run(
+        wf2.arun_episode(_Eng(), {"messages": [{"role": "user", "content": "q"}]})
+    )
+    assert (batch2["rewards"] == 1.0).all()
+
+
+@pytest.mark.slow
+def test_tir_example_end_to_end(tmp_path):
+    ckpt = tmp_path / "model"
+    make_tiny_ckpt(str(ckpt))
+    data = make_gsm8k_jsonl(str(tmp_path / "train.jsonl"), n=8)
+    fileroot = tmp_path / "exp"
+    cfg = f"""
+experiment_name: tirsmoke
+trial_name: t0
+seed: 1
+total_train_epochs: 1
+total_train_steps: 1
+async_training: true
+workflow: tir
+tokenizer_path: {ckpt}
+cluster:
+  fileroot: {fileroot}
+allocation_mode: "jax:d1+jax:d1"
+train_dataset:
+  path: {data}
+  type: gsm8k
+  batch_size: 4
+  max_length: 128
+gconfig:
+  n_samples: 2
+  max_new_tokens: 16
+  temperature: 1.0
+rollout:
+  max_concurrent_rollouts: 8
+  consumer_batch_size: 4
+  max_head_offpolicyness: 2
+  request_timeout: 120
+gen_server:
+  model_path: {ckpt}
+  max_seqs: 4
+  max_context_len: 256
+actor:
+  path: {ckpt}
+  dtype: float32
+  gradient_checkpointing: false
+  group_size: 2
+  ppo_n_minibatches: 1
+  pack_length_quantum: 64
+  max_pack_length: 256
+  adv_norm:
+    mean_level: group
+    std_level: group
+  optimizer:
+    lr: 1.0e-4
+    warmup_steps_proportion: 0.0
+saver:
+  freq_steps: null
+checkpointer:
+  freq_steps: null
+evaluator:
+  freq_steps: null
+recover:
+  mode: disabled
+stats_logger:
+  fileroot: {fileroot}
+"""
+    cfg_path = tmp_path / "cfg.yaml"
+    cfg_path.write_text(cfg)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "areal_tpu.launcher.local",
+         os.path.join(REPO, "examples/math/gsm8k_grpo.py"),
+         "--config", str(cfg_path)],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=540)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"launcher timed out.\n{out[-4000:]}")
+
+    log_dir = fileroot / "tirsmoke" / "t0" / "logs"
+    trainer_log = ""
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            if f.name.startswith("trainer"):
+                trainer_log += f.read_text()
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out[-2000:]}\n{trainer_log[-4000:]}"
+    assert "Step 1/" in trainer_log and "done." in trainer_log, trainer_log[-4000:]

@@ -130,22 +130,26 @@ def test_vlm_grpo_update_microbatched():
         batch = _vlm_batch(rng, B=4)
         batch["patches_per_row"] = np.full(4, 16, np.int64)
         batch["prox_logp"] = actor.compute_logp(batch)
+        actor.compute_advantages(batch)
 
-        # parity BEFORE any update: same init, logp must not depend on the
-        # engine's micro-batch setting
-        cfg1 = _cfg()
-        actor1 = JaxVLMPPOActor(cfg1, model_config=_model_cfg())
+        # REAL carve coverage: an identically-initialised n_mbs=1 actor's
+        # update must agree on loss and grad norm — a span off-by-one that
+        # pairs rows with wrong images would change both
+        actor1 = JaxVLMPPOActor(_cfg(), model_config=_model_cfg())
         actor1.initialize(ft_spec=FinetuneSpec(1, 64, 8))
         try:
-            l1 = actor1.compute_logp(batch)
+            stats1 = actor1.ppo_update(dict(batch))
+            stats2 = actor.ppo_update(dict(batch))
             np.testing.assert_allclose(
-                l1, batch["prox_logp"], rtol=1e-5, atol=1e-5
+                stats1[-1]["loss"], stats2[-1]["loss"], rtol=1e-4, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                stats1[-1]["grad_norm"], stats2[-1]["grad_norm"],
+                rtol=1e-4, atol=1e-6,
             )
         finally:
             actor1.destroy()
-
-        actor.compute_advantages(batch)
-        stats = actor.ppo_update(batch)
+        stats = stats2
         assert np.isfinite(stats[-1]["loss"])
 
         # micro-batching without spans is refused loudly
