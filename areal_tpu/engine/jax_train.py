@@ -59,6 +59,7 @@ from areal_tpu.parallel import (
     shard_pytree,
 )
 from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils import stats as tracker
 from areal_tpu.utils.data import (
     RowPackedBatch,
     pack_into_rows,
@@ -96,6 +97,8 @@ class JaxTrainEngine(TrainEngine):
         self._train_step_cache: Dict[Tuple, Callable] = {}
         self._forward_cache: Dict[Tuple, Callable] = {}
         self._ft_spec: Optional[FinetuneSpec] = None
+        self._transfer_executor = None  # lazy: weight-transfer push thread
+        self.last_weight_update_seconds: Optional[float] = None
         self.initialized = False
         # the jitted step functions call self._model_fn(params, cfg, ids,
         # positions, segment_ids, mesh=mesh); the default returns a deferred
@@ -153,6 +156,7 @@ class JaxTrainEngine(TrainEngine):
             param_dtype=cfg.param_dtype,
             remat=cfg.gradient_checkpointing,
             remat_policy=getattr(cfg, "remat_policy", "full"),
+            scan_unroll=getattr(cfg, "scan_unroll", 1),
         )
         if getattr(cfg, "lora", None) is not None and cfg.lora.enabled:
             from areal_tpu.models.lora import add_lora_params
@@ -168,6 +172,13 @@ class JaxTrainEngine(TrainEngine):
         specs = param_partition_specs(
             self.model_config, tp=self.mesh.shape["tp"]
         )
+        # subtrees the text-model spec doesn't know (e.g. the vision tower
+        # loaded from a VLM checkpoint) are small: replicate them
+        for key in host_params:
+            if key not in specs:
+                specs[key] = jax.tree_util.tree_map(
+                    lambda _: P(), host_params[key]
+                )
         self.params = shard_pytree(self.mesh, host_params, specs)
 
         if cfg.optimizer is not None:
@@ -241,6 +252,9 @@ class JaxTrainEngine(TrainEngine):
         self.opt_state = None
         self._train_step_cache.clear()
         self._forward_cache.clear()
+        if self._transfer_executor is not None:
+            self._transfer_executor.shutdown(wait=False)
+            self._transfer_executor = None
         self.initialized = False
 
     # ------------------------------------------------------------------
@@ -418,13 +432,28 @@ class JaxTrainEngine(TrainEngine):
                 # optax evaluates the schedule at the pre-increment count
                 jnp.int32(self.step_count),
             )
+        self.step_count += 1
+        if self.config.async_stats:
+            # deferred fetch: the caller reads stats later (one batched
+            # transfer), so the NEXT step can be dispatched while this one
+            # still runs — per-step step_time/tflops/mfu are omitted because
+            # there is no sync point to measure them against
+            pending = tracker.PendingTrainStats(
+                stats,
+                lambda tree: {
+                    k: float(v)
+                    for k, v in distributed.fetch_replicated(tree).items()
+                },
+            )
+            return pending.then(
+                lambda st: {**st, "total_loss_weight": total_weight}
+            )
         # ONE host transfer for every stat; per-scalar float() would pay a
         # device round-trip each.  Stats are replicated reductions, so each
         # process reads its own full replica.
         stats = {
             k: float(v) for k, v in distributed.fetch_replicated(stats).items()
         }
-        self.step_count += 1
         stats["total_loss_weight"] = total_weight
         stats["step_time"] = time.perf_counter() - t0
         # per-chip MFU from the analytic flops model (the role of the
@@ -646,26 +675,37 @@ class JaxTrainEngine(TrainEngine):
 
     def _update_weights_transfer(self, meta: WeightUpdateMeta) -> None:
         """Chunk-streamed push: each HF-named array is sliced into
-        <= chunk_mb byte pieces, POSTed to every server, then committed
-        (server assembles by (name, offset) — gen/server.py)."""
+        <= chunk_mb byte pieces, POSTed to every server as raw
+        `application/octet-stream` bodies (name/dtype/shape/offset in
+        X-Weight-* headers — no base64 inflation or per-chunk json parse),
+        then committed (server assembles by (name, offset) — gen/server.py).
+
+        The asyncio push runs on a dedicated transfer thread, not the
+        caller's (the trainer thread may own its own event loop); the call
+        still blocks until the fleet commits — pause→update→resume is a
+        synchronous control-plane action.  The measured wall time lands in
+        `self.last_weight_update_seconds`."""
         import asyncio
-        import base64
+        import json as _json
 
         import ml_dtypes
 
         from areal_tpu.models.hf import params_to_hf_state
-        from areal_tpu.utils.http import arequest_with_retry
+        from areal_tpu.utils.http import (
+            apost_bytes_with_retry,
+            arequest_with_retry,
+        )
 
         host = self._export_params()
         if not distributed.is_head():
             return
+        t0 = time.perf_counter()
         addrs = self._server_addrs(meta)
         bf16 = np.dtype(ml_dtypes.bfloat16)
         chunk_bytes = max(1, meta.chunk_mb) << 20
         # bf16 raw bytes are built while the host tree is alive (fp32
         # masters: transient ~3x model bytes), then the host tree is
-        # dropped so only ~1x bf16 remains for the push; base64 is produced
-        # one chunk at a time inside push()
+        # dropped so only ~1x bf16 remains for the push
         state = [
             (name, np.ascontiguousarray(arr.astype(bf16)).tobytes(), list(arr.shape))
             for name, arr in params_to_hf_state(host, self.model_config)
@@ -674,36 +714,50 @@ class JaxTrainEngine(TrainEngine):
         version = self._version
 
         async def push(addr: str):
-            for name, raw, shape in state:
-                for off in range(0, len(raw) or 1, chunk_bytes):
-                    await arequest_with_retry(
-                        addr=addr,
-                        endpoint="/update_weights_chunk",
-                        payload={
-                            "name": name,
-                            "dtype": "bfloat16",
-                            "shape": shape,
-                            "nbytes": len(raw),
-                            "offset": off,
-                            "data_b64": base64.b64encode(
-                                raw[off : off + chunk_bytes]
-                            ).decode(),
-                        },
-                        method="POST",
-                        timeout=300.0,
-                    )
-            await arequest_with_retry(
-                addr=addr,
-                endpoint="/update_weights_chunk",
-                payload={"commit": True, "version": version},
-                method="POST",
-                timeout=600.0,
-            )
+            import aiohttp
+
+            from areal_tpu.utils.http import get_default_connector
+
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600.0, sock_connect=30.0),
+                connector=get_default_connector(),
+            ) as session:
+                for name, raw, shape in state:
+                    meta_hdrs = {
+                        "X-Weight-Name": name,
+                        "X-Weight-Dtype": "bfloat16",
+                        "X-Weight-Shape": _json.dumps(shape),
+                        "X-Weight-Nbytes": str(len(raw)),
+                    }
+                    for off in range(0, len(raw) or 1, chunk_bytes):
+                        await apost_bytes_with_retry(
+                            addr=addr,
+                            endpoint="/update_weights_chunk",
+                            data=raw[off : off + chunk_bytes],
+                            headers={**meta_hdrs, "X-Weight-Offset": str(off)},
+                            timeout=300.0,
+                            session=session,
+                        )
+                await arequest_with_retry(
+                    addr=addr,
+                    endpoint="/update_weights_chunk",
+                    payload={"commit": True, "version": version},
+                    method="POST",
+                    timeout=600.0,
+                    session=session,
+                )
 
         async def run():
             await asyncio.gather(*[push(a) for a in addrs])
 
-        asyncio.run(run())
+        if self._transfer_executor is None:
+            import concurrent.futures
+
+            self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="weight-transfer"
+            )
+        self._transfer_executor.submit(asyncio.run, run()).result()
+        self.last_weight_update_seconds = time.perf_counter() - t0
 
     def save(self, meta: SaveLoadMeta) -> None:
         """Model weights as an HF safetensors dir (interop with inference

@@ -61,6 +61,7 @@ class PPOActor:
     def __init__(self, config: PPOActorConfig, engine):
         self.config = config
         self.engine = engine
+        self._pending_stats: List[stats.PendingTrainStats] = []
         if config.adv_norm is not None:
             # NormConfig.group_size overrides when set; default to the GRPO
             # group size so the common case needs no duplication
@@ -232,6 +233,13 @@ class PPOActor:
             all_stats.append(self._train_one_mb(mb))
         return all_stats
 
+    def flush_stats(self) -> None:
+        """Materialise every deferred stats fetch (async_stats mode); call
+        before reading the tracker/logging so commits are complete."""
+        for st in self._pending_stats:
+            st.materialize()
+        self._pending_stats.clear()
+
     def _build_loss_fn(self):
         """The cached grpo loss partial (built ONCE: the compiled step is
         keyed on the callable's identity)."""
@@ -246,9 +254,13 @@ class PPOActor:
             eps_clip_higher=cfg.eps_clip_higher,
         )
 
-    def _train_one_mb(self, mb: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def _train_one_mb(self, mb: Dict[str, np.ndarray]):
         """One train_batch + stat normalisation + tracker commit — shared
-        with VLM/recipe actors so their stats cannot drift from the base."""
+        with VLM/recipe actors so their stats cannot drift from the base.
+
+        With `async_stats` the engine returns a PendingTrainStats; the
+        normalisation/commit below runs when the stats materialise, so the
+        next step's dispatch is never blocked on this one's scalars."""
         if not hasattr(self, "_loss_fn"):
             self._loss_fn = self._build_loss_fn()
         st = self.engine.train_batch(
@@ -256,6 +268,15 @@ class PPOActor:
             self._loss_fn,
             loss_weight_fn=lambda b: float(np.sum(b["loss_mask"])),
         )
+        if isinstance(st, stats.PendingTrainStats):
+            st.then(self._finalize_mb_stats)
+            # registered here (the one chokepoint) so flush_stats always
+            # covers every pending fetch, whichever actor path dispatched it
+            self._pending_stats.append(st)
+            return st
+        return self._finalize_mb_stats(st)
+
+    def _finalize_mb_stats(self, st: Dict[str, float]) -> Dict[str, float]:
         n = max(st.pop("n_valid_tokens", 1.0), 1.0)
         for k in self.PER_TOKEN_STAT_KEYS:
             if k in st:
@@ -284,3 +305,6 @@ class JaxPPOActor(JaxTrainEngine):
 
     def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
         return self.actor.ppo_update(batch)
+
+    def flush_stats(self) -> None:
+        self.actor.flush_stats()

@@ -183,8 +183,12 @@ def _backbone(
         x, aux = layer_fn(lp, x, cos, sin, segment_ids, positions, mask)
         return (x, aux_sum + aux), None
 
+    unroll = cfg.scan_unroll if cfg.num_layers % max(cfg.scan_unroll, 1) == 0 else 1
     (x, aux), _ = jax.lax.scan(
-        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        scan_body,
+        (x, jnp.zeros((), jnp.float32)),
+        params["layers"],
+        unroll=max(1, unroll),
     )
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), aux
 

@@ -34,6 +34,88 @@ def _asarray(x) -> np.ndarray:
     return arr
 
 
+class PendingTrainStats:
+    """Train-step stats whose device→host fetch is deferred (Mapping-like).
+
+    A per-step blocking stats fetch serialises the trainer on dispatch
+    latency: the host cannot enqueue step N+1 until step N's scalars have
+    crossed the wire (expensive on tunneled/remote TPU runtimes — measured
+    ~150 ms/step on v5e behind a network hop).  Deferring the fetch lets XLA
+    pipeline steps back-to-back; reading any key materialises the stats (one
+    batched transfer) and runs the registered finalizers (normalisation +
+    tracker commit), preserving the sync path's observable behavior, just
+    later.
+    """
+
+    def __init__(self, device_stats: Dict[str, Any], fetch: Callable):
+        # issue async copies now so the transfer overlaps device compute
+        for v in device_stats.values():
+            if hasattr(v, "copy_to_host_async"):
+                try:
+                    v.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path
+                    pass
+        self._device_stats = device_stats
+        self._fetch = fetch
+        self._finalizers: List[Callable] = []
+        self._result: Optional[Dict[str, float]] = None
+
+    def then(self, fn: Callable) -> "PendingTrainStats":
+        """Register `fn(stats_dict) -> stats_dict` to run at materialisation."""
+        if self._result is not None:
+            self._result = fn(self._result)
+        else:
+            self._finalizers.append(fn)
+        return self
+
+    def materialize(self) -> Dict[str, float]:
+        if self._result is None:
+            out = self._fetch(self._device_stats)
+            self._device_stats = None
+            for fn in self._finalizers:
+                out = fn(out)
+            self._finalizers = []
+            self._result = out
+        return self._result
+
+    # Mapping surface — any read materialises
+    def __getitem__(self, key):
+        return self.materialize()[key]
+
+    def __contains__(self, key):
+        return key in self.materialize()
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __len__(self):
+        return len(self.materialize())
+
+    def keys(self):
+        return self.materialize().keys()
+
+    def values(self):
+        return self.materialize().values()
+
+    def items(self):
+        return self.materialize().items()
+
+    def get(self, key, default=None):
+        return self.materialize().get(key, default)
+
+    def pop(self, key, *default):
+        return self.materialize().pop(key, *default)
+
+    def __setitem__(self, key, value):
+        # callers annotate stats in place (e.g. sft/rw engines' ppl/acc);
+        # writing forces materialisation so ordering stays deterministic
+        self.materialize()[key] = value
+
+    def __repr__(self):
+        state = "pending" if self._result is None else repr(self._result)
+        return f"PendingTrainStats({state})"
+
+
 class StatsTracker:
     """Accumulates masked statistics under hierarchical scopes.
 

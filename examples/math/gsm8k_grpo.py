@@ -131,10 +131,37 @@ def main(argv):
             TIRMathAgent(config.gconfig, tokenizer=tokenizer),
             env_factory=lambda data: MathVerifyEnv(answer=data["answer"]),
         )
+    elif config.workflow == "countdown":
+        # arithmetic-game RL (reference: examples/countdown) — dataset rows
+        # carry (numbers, target); the env verifies the boxed expression
+        from areal_tpu.agent import AgentWorkflow, MathSingleStepAgent
+        from areal_tpu.agent.countdown_env import CountdownEnv
+
+        workflow = AgentWorkflow(
+            MathSingleStepAgent(config.gconfig, tokenizer=tokenizer),
+            env_factory=lambda data: CountdownEnv(
+                data["numbers"], data["target"]
+            ),
+        )
+    elif config.workflow == "search":
+        # search-agent RL (reference: examples/search-agent) — the model
+        # issues <search> queries against the episode's corpus mid-rollout
+        from areal_tpu.agent import AgentWorkflow, SearchQAAgent
+        from areal_tpu.agent.search_env import LocalSearchEnv
+
+        workflow = AgentWorkflow(
+            SearchQAAgent(config.gconfig, tokenizer=tokenizer),
+            # the dataset attaches one shared BM25 index; per-row corpora
+            # (no index) still work, just slower
+            env_factory=lambda data: LocalSearchEnv(
+                data["corpus"], data["answer"],
+                index=data.get("_search_index"),
+            ),
+        )
     elif config.workflow != "rlvr":
         raise ValueError(
             f"unknown workflow {config.workflow!r}; use 'rlvr', "
-            "'multi_turn', or 'tir'"
+            "'multi_turn', 'tir', 'countdown', or 'search'"
         )
     else:
         workflow = RLVRWorkflow(
@@ -245,6 +272,10 @@ def main(argv):
 
             evaluator.evaluate(evaluate_fn, epoch, epoch_step, global_step)
 
+        # async_stats: materialise any deferred train-step stats (their
+        # tracker commits run here) before exporting the step's metrics —
+        # by now the device has finished, so this costs one cheap transfer
+        actor.flush_stats()
         reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
         stats.scalar(reward=reward_mean, n_seqs=len(batch.get("rewards", [])))
         stats_logger.commit(

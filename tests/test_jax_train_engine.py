@@ -157,3 +157,38 @@ def test_save_load_roundtrip(tmp_path):
     s1 = eng.train_batch(batch, sft_loss_fn, _weight)
     s2 = eng2.train_batch(batch, sft_loss_fn, _weight)
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-4)
+
+
+def test_async_stats_pipeline_matches_sync():
+    """async_stats defers the fetch; numbers must equal the sync path and
+    the tracker commit must happen at materialisation, not dispatch."""
+    from areal_tpu.utils import stats as stats_mod
+
+    rng = np.random.default_rng(7)
+    batch = _batch(rng)
+    mesh = MeshConfig(data_parallel_size=2, fsdp_parallel_size=2,
+                      tensor_parallel_size=2)
+
+    sync_eng = _engine(mesh)
+    async_eng = _engine(mesh)
+    async_eng.config.async_stats = True
+
+    sync_losses, pendings = [], []
+    for _ in range(4):
+        sync_losses.append(sync_eng.train_batch(batch, sft_loss_fn, _weight)["loss"])
+        pendings.append(async_eng.train_batch(batch, sft_loss_fn, _weight))
+    for p in pendings:
+        assert isinstance(p, stats_mod.PendingTrainStats)
+        assert p._result is None  # not yet materialised
+    async_losses = [p["loss"] for p in pendings]  # read -> materialise
+    np.testing.assert_allclose(async_losses, sync_losses, rtol=1e-5)
+    # async mode omits per-step wall-clock-derived keys (no sync point)
+    assert "step_time" not in pendings[0].materialize()
+    assert pendings[0]["total_loss_weight"] == _weight(batch)
+    # finalizers registered via .then run once, at materialisation
+    seen = []
+    p = async_eng.train_batch(batch, sft_loss_fn, _weight)
+    p.then(lambda st: (seen.append(True), st)[1])
+    assert not seen
+    _ = p["loss"]
+    assert seen == [True]
