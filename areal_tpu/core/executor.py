@@ -81,12 +81,26 @@ class WorkflowExecutor:
         self._pending_results: List[Dict[str, Any]] = []
         self._expected_keys: Optional[Set[str]] = None
         self._data_generator = None
+        # optional fleet-wide admission gate (set by RemoteInfEngine when a
+        # router is discovered): with N clients sharing one generation fleet,
+        # the local StalenessManager alone would overshoot the global
+        # staleness budget N-fold (reference gserver_manager.py:334)
+        self.fleet_gate = None
 
     # --- lifecycle ---
     def initialize(self):
         self.runner.start()
 
     def destroy(self):
+        if self.fleet_gate is not None and self.runner._loop is not None:
+            import asyncio
+
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.fleet_gate.aclose(), self.runner._loop
+                ).result(timeout=5)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         self.runner.stop()
 
     # --- capacity ---
@@ -97,20 +111,35 @@ class WorkflowExecutor:
     # --- episode wrapper ---
     def _make_task(self, ti: _TaskInput):
         async def _run():
+            alloc_id = None
+            if self.fleet_gate is not None:
+                qid = str(ti.data.get("query_id", "")) if isinstance(ti.data, dict) else ""
+                alloc_id = await self.fleet_gate.allocate(qid)
+            # the lease MUST be returned on every exit path (format-check
+            # and should_accept errors included) or it sits in the router's
+            # _running until the TTL, eating fleet admission budget
+            accept = False
             try:
-                traj = await ti.workflow.arun_episode(self.inference_engine, ti.data)
-            except BaseException:
-                # the submit-side increment must be balanced even on failure,
-                # or every crashed episode permanently eats one capacity slot
-                self.staleness_manager.on_rollout_rejected()
-                raise
-            if traj is not None and self.config.check_trajectory_format:
-                check_trajectory_format(traj, self._expected_keys)
-                if self._expected_keys is None and "input_ids" in traj:
-                    self._expected_keys = set(traj.keys())
-            accept = traj is not None and (
-                ti.should_accept is None or ti.should_accept(traj)
-            )
+                try:
+                    traj = await ti.workflow.arun_episode(
+                        self.inference_engine, ti.data
+                    )
+                except BaseException:
+                    # the submit-side increment must be balanced even on
+                    # failure, or every crashed episode permanently eats one
+                    # capacity slot
+                    self.staleness_manager.on_rollout_rejected()
+                    raise
+                if traj is not None and self.config.check_trajectory_format:
+                    check_trajectory_format(traj, self._expected_keys)
+                    if self._expected_keys is None and "input_ids" in traj:
+                        self._expected_keys = set(traj.keys())
+                accept = traj is not None and (
+                    ti.should_accept is None or ti.should_accept(traj)
+                )
+            finally:
+                if self.fleet_gate is not None:
+                    await self.fleet_gate.finish(alloc_id, accepted=accept)
             if accept:
                 self.staleness_manager.on_rollout_accepted()
                 if self.config.enable_rollout_tracing:

@@ -45,6 +45,89 @@ logger = logging.getLogger("remote_engine")
 RID_CACHE_SIZE = 128
 
 
+class FleetStalenessGate:
+    """Client stub for the router's fleet-wide staleness gate.
+
+    The reference's GserverManager gates rollout admission globally across
+    every rollout worker (realhf/system/gserver_manager.py:334 `is_staled`,
+    :175-191): N clients against one fleet must share one staleness budget,
+    not apply N local ones.  `allocate` polls `/allocate_request` until the
+    router grants a lease (409 = fleet staleness-bound); `finish` returns the
+    lease via `/finish_request`.  If the router becomes unreachable the gate
+    degrades to a no-op so rollout falls back to the local StalenessManager
+    rather than deadlocking.
+    """
+
+    def __init__(
+        self,
+        router_addr: str,
+        poll_interval: float = 0.5,
+        max_failures: int = 5,
+    ):
+        self.router_addr = router_addr
+        self.poll_interval = poll_interval
+        self.max_failures = max_failures
+        self._failures = 0
+        self._disabled = False
+        # lazily bound to the runner's event loop on first use
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60.0, sock_connect=15.0),
+                connector=get_default_connector(),
+            )
+        return self._session
+
+    async def allocate(self, qid: str) -> Optional[str]:
+        """Block until the fleet grants an admission; returns the lease id
+        (None when the gate is unreachable/disabled)."""
+        while not self._disabled:
+            try:
+                async with self._get_session().post(
+                    f"http://{self.router_addr}/allocate_request",
+                    json={"qid": qid},
+                ) as resp:
+                    if resp.status == 200:
+                        self._failures = 0
+                        return (await resp.json()).get("alloc_id")
+                    if resp.status == 409:  # fleet staleness-bound: the
+                        # router is alive and answering — not a failure
+                        self._failures = 0
+                    else:
+                        raise RuntimeError(f"allocate -> HTTP {resp.status}")
+            except Exception as e:  # noqa: BLE001 — degrade, don't deadlock
+                self._failures += 1
+                if self._failures >= self.max_failures:
+                    logger.warning(
+                        f"fleet staleness gate unreachable ({e}); falling "
+                        f"back to the local StalenessManager"
+                    )
+                    self._disabled = True
+                    return None
+            await asyncio.sleep(self.poll_interval)
+        return None
+
+    async def finish(self, alloc_id: Optional[str], accepted: bool) -> None:
+        # a KNOWN lease is returned even after the gate degraded — leaving
+        # it to the TTL would eat a fleet admission for up to an hour
+        if alloc_id is None:
+            return
+        try:
+            async with self._get_session().post(
+                f"http://{self.router_addr}/finish_request",
+                json={"alloc_id": alloc_id, "accepted": accepted},
+            ) as resp:
+                resp.raise_for_status()
+        except Exception as e:  # noqa: BLE001 — the router TTLs the lease
+            logger.warning(f"finish_request failed (lease will expire): {e}")
+
+    async def aclose(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
 class RemoteInfBackendProtocol(Protocol):
     """Builds/parses the HTTP wire format of a server family."""
 
@@ -91,7 +174,27 @@ class RemoteInfEngine(InferenceEngine):
             raise RuntimeError("no generation servers found")
         self._inflight = {a: 0 for a in self.addresses}
         logger.info(f"remote engine using servers: {self.addresses}")
+        router_addr = self._discover_router()
+        if router_addr:
+            logger.info(f"fleet staleness gate via router at {router_addr}")
+            self.executor.fleet_gate = FleetStalenessGate(router_addr)
         self.executor.initialize()
+
+    def _discover_router(self) -> Optional[str]:
+        """Non-blocking router discovery: env override, then name_resolve.
+        A registered router means this client is one of possibly many sharing
+        a generation fleet, so admission must be gated fleet-wide."""
+        env = os.environ.get("AREAL_GEN_ROUTER_ADDR")
+        if env:
+            return env or None
+        try:
+            return name_resolve.get(
+                names.gen_router(
+                    self.config.experiment_name, self.config.trial_name
+                )
+            )
+        except Exception:  # noqa: BLE001 — no router registered
+            return None
 
     def _discover_servers(self) -> List[str]:
         env = os.environ.get("AREAL_LLM_SERVER_ADDRS")

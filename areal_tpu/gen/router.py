@@ -24,6 +24,7 @@ the router exactly as it would at one big server.
 import argparse
 import asyncio
 import time
+import uuid
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -50,6 +51,9 @@ class RouterConfig:
     weights_path: str = ""  # trainer's WeightUpdateMeta.path; ckpts at v{N}/
     poll_interval: float = 1.0
     request_timeout: float = 3600.0
+    # allocations older than this are reclaimed, so a client that crashed
+    # mid-episode cannot permanently wedge fleet admission (0 => request_timeout)
+    alloc_ttl: float = 0.0
 
 
 class Router:
@@ -59,10 +63,12 @@ class Router:
         self.version = 0
         self._rr = 0
         self._inflight: Dict[str, int] = {}
-        self._tokens: Dict[str, int] = {}
+        self._routed: Dict[str, int] = {}  # cumulative requests per backend
+        self._tokens: Dict[str, int] = {}  # live in-flight tokens per backend
         self._rid_to_addr: "OrderedDict[str, str]" = OrderedDict()
-        # global rollout accounting for the staleness gate
-        self._running = 0
+        # global rollout accounting for the staleness gate; allocations carry
+        # a lease timestamp so orphans (crashed clients) age out
+        self._running: Dict[str, float] = {}
         self._accepted = 0
         self._lock = asyncio.Lock()
         self._flush_lock = asyncio.Lock()
@@ -95,6 +101,16 @@ class Router:
 
     # ------------------------- staleness gate ---------------------------
 
+    def _prune_allocations(self) -> None:
+        """Reclaim leases whose client never called /finish_request."""
+        ttl = self.config.alloc_ttl or self.config.request_timeout
+        cutoff = time.monotonic() - ttl
+        stale = [aid for aid, t in self._running.items() if t < cutoff]
+        for aid in stale:
+            del self._running[aid]
+        if stale:
+            logger.warning(f"reclaimed {len(stale)} expired rollout allocations")
+
     def _capacity(self) -> Optional[int]:
         """Remaining global admissions, or None when the gate is disabled.
 
@@ -104,20 +120,24 @@ class Router:
         bs = self.config.train_batch_size
         if bs <= 0:
             return None
+        self._prune_allocations()
         allowed = (self.config.max_head_offpolicyness + self.version + 1) * bs
-        return allowed - (self._running + self._accepted)
+        return allowed - (len(self._running) + self._accepted)
 
     # ---------------------------- handlers ------------------------------
 
     async def generate(self, request: web.Request) -> web.Response:
         body = await request.json()
         rid = body.get("rid", "")
+        # _tokens tracks tokens currently resident on each backend (a proxy
+        # for live KV usage, the reference's least_token_usage signal) — NOT
+        # a cumulative history, so finished requests free their share
+        n_prompt = len(body.get("input_ids", ()))
         async with self._lock:
             addr = self._server_for_rid(rid)
             self._inflight[addr] = self._inflight.get(addr, 0) + 1
-            self._tokens[addr] = self._tokens.get(addr, 0) + len(
-                body.get("input_ids", ())
-            )
+            self._routed[addr] = self._routed.get(addr, 0) + 1
+            self._tokens[addr] = self._tokens.get(addr, 0) + n_prompt
         try:
             async with self._session.post(
                 f"http://{addr}/generate", json=body
@@ -127,33 +147,49 @@ class Router:
         finally:
             async with self._lock:
                 self._inflight[addr] = self._inflight.get(addr, 1) - 1
-        if status == 200:
-            async with self._lock:
-                self._tokens[addr] = self._tokens.get(addr, 0) + len(
-                    payload.get("output_tokens", ())
-                )
+                self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_prompt)
         return web.json_response(payload, status=status)
 
     async def allocate_request(self, request: web.Request) -> web.Response:
-        """Admission control for a new rollout sample.  Returns the server
-        the client should use, or 409 when the fleet is staleness-bound."""
-        body = await request.json()
+        """Admission control for a new rollout sample.  Returns an allocation
+        lease + the server the client should use, or 409 when the fleet is
+        staleness-bound (reference is_staled, gserver_manager.py:334)."""
+        await request.json()  # body reserved for future fields (qid, ...)
         async with self._lock:
             cap = self._capacity()
-            if cap is not None and cap <= 0:
+            if cap is None:
+                # gate disabled (train_batch_size=0): admit freely WITHOUT
+                # a lease — leases would never be pruned (no capacity
+                # checks) and crashed clients would leak them forever
+                return web.json_response(
+                    {"version": self.version, "staled": False,
+                     "alloc_id": None}
+                )
+            if cap <= 0:
                 return web.json_response(
                     {"staled": True, "version": self.version}, status=409
                 )
-            self._running += 1
-            addr = self._server_for_rid(body.get("qid", ""))
+            alloc_id = uuid.uuid4().hex
+            self._running[alloc_id] = time.monotonic()
+        # note: no _server_for_rid here — the client routes its own
+        # /generate traffic, and inserting one-shot qids would evict live
+        # rid affinities from the LRU
         return web.json_response(
-            {"server": addr, "version": self.version, "staled": False}
+            {"version": self.version, "staled": False, "alloc_id": alloc_id}
         )
 
     async def finish_request(self, request: web.Request) -> web.Response:
         body = await request.json()
+        alloc_id = body.get("alloc_id", "")
         async with self._lock:
-            self._running = max(0, self._running - 1)
+            if alloc_id in self._running:
+                del self._running[alloc_id]
+            elif not alloc_id and self._running:
+                # legacy caller without a lease id: free the oldest.  A
+                # KNOWN-but-absent id (TTL-pruned lease) must NOT pop some
+                # other client's live lease — that would double-free
+                # admissions and let the fleet overshoot the budget.
+                self._running.pop(next(iter(self._running)))
             if body.get("accepted", True):
                 self._accepted += 1
         return web.json_response({"ok": True})
@@ -201,8 +237,9 @@ class Router:
                 {
                     "version": self.version,
                     "inflight": dict(self._inflight),
-                    "tokens_routed": dict(self._tokens),
-                    "running": self._running,
+                    "requests_routed": dict(self._routed),
+                    "tokens_inflight": dict(self._tokens),
+                    "running": len(self._running),
                     "accepted": self._accepted,
                     "capacity": cap,
                     "n_flushes": self.n_flushes,
@@ -293,6 +330,7 @@ class Router:
         if not self.addresses:
             self.addresses = await self._discover()
         self._inflight = {a: 0 for a in self.addresses}
+        self._routed = {a: 0 for a in self.addresses}
         self._tokens = {a: 0 for a in self.addresses}
         if self.config.weights_path and self.config.experiment_name:
             self._watcher = asyncio.create_task(self._watch_checkpoints())

@@ -128,7 +128,9 @@ def test_routing_policies_and_affinity(fleet):
         assert sorted(counts2) == [0, 0, 3], counts2
 
         metrics = _get(raddr, "/metrics")
-        assert sum(metrics["tokens_routed"].values()) > 0
+        assert sum(metrics["requests_routed"].values()) == 9
+        # _tokens is live in-flight load: freed once requests complete
+        assert all(v == 0 for v in metrics["tokens_inflight"].values())
     finally:
         h.stop()
 
@@ -215,4 +217,100 @@ def test_checkpoint_watcher_picks_up_trainer_publishes(fleet, tmp_path):
         for s in servers:
             assert s.weight_updates and s.weight_updates[-1]["path"].endswith("/v3")
     finally:
+        h.stop()
+
+
+def test_fleet_gate_two_clients_share_one_budget(fleet, monkeypatch):
+    """VERDICT r2 #2: N clients against one fleet must share ONE staleness
+    budget (reference is_staled, gserver_manager.py:334).  Two RemoteJaxEngine
+    clients with permissive LOCAL staleness run against a router whose gate
+    allows 4 admissions at v0; the fleet must admit exactly 4 episodes total
+    until a weight update raises the version."""
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+    from areal_tpu.api.config import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+    )
+    from areal_tpu.api.workflow import RolloutWorkflow
+    from areal_tpu.engine.jax_remote import RemoteJaxEngine
+
+    servers, addrs = fleet
+    router = Router(
+        RouterConfig(
+            train_batch_size=2,  # (eta=0 + v + 1) * 2 -> 4 admissions at v=1
+            max_head_offpolicyness=0,
+            schedule_policy="round_robin",
+        ),
+        addresses=addrs,
+    )
+    router.version = 1
+    h = RouterHarness(router)
+    raddr = h.start()
+    monkeypatch.setenv("AREAL_GEN_ROUTER_ADDR", raddr)
+
+    class _W(RolloutWorkflow):
+        async def arun_episode(self, engine, data):
+            from areal_tpu.api.io_struct import ModelRequest
+
+            resp = await engine.agenerate(ModelRequest(
+                rid=str(data["query_id"]),
+                input_ids=[1, 2, 3],
+                gconfig=GenerationHyperparameters(max_new_tokens=8),
+            ))
+            ids = [1, 2, 3] + resp.output_tokens
+            return {
+                "input_ids": np.array([ids], np.int32),
+                "attention_mask": np.ones((1, len(ids)), bool),
+            }
+
+    clients = []
+    for i in range(2):
+        c = RemoteJaxEngine(InferenceEngineConfig(
+            experiment_name="fg", trial_name=f"c{i}", consumer_batch_size=4,
+            max_concurrent_rollouts=16, max_head_offpolicyness=100,
+            request_timeout=10,
+        ))
+        c.initialize(addr=raddr)  # generation also proxies through the router
+        assert c.executor.fleet_gate is not None
+        # fast poll so the post-update drain happens within test time
+        c.executor.fleet_gate.poll_interval = 0.1
+        clients.append(c)
+
+    results = {}
+
+    def _run(idx):
+        results[idx] = clients[idx].rollout_batch(
+            [{"query_id": f"{idx}-{j}"} for j in range(4)], workflow=_W()
+        )
+
+    threads = [_threading.Thread(target=_run, args=(i,)) for i in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        # steady state before the weight update: exactly 4 admissions
+        # fleet-wide (accepted + running), the other 4 episodes blocked
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            with_lease = router._accepted + len(router._running)
+            if router._accepted >= 4:
+                break
+            _time.sleep(0.05)
+        _time.sleep(0.5)  # would-be overshoot window
+        assert router._accepted + len(router._running) <= 4
+        assert router._accepted == 4
+
+        # weight update -> version 3 -> budget (0+3+1)*2 = 8: all drain
+        _post(raddr, "/update_weights", {"path": "/dev/null/v3", "version": 3})
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        assert results[0]["input_ids"].shape[0] == 4
+        assert results[1]["input_ids"].shape[0] == 4
+        assert router._accepted == 8
+    finally:
+        for c in clients:
+            c.destroy()
         h.stop()

@@ -99,7 +99,7 @@ def test_client_through_router_to_real_servers(tmp_path):
         assert batch["input_ids"].shape[0] == 4
         assert (batch["rewards"] == 1.0).all()
         # both real engines served traffic (round-robin proxy)
-        assert all(v > 0 for v in router._tokens.values())
+        assert all(v > 0 for v in router._routed.values())
 
         # a weight update THROUGH the router flushes every real engine:
         # pause fleet-wide, load the checkpoint, resume, bump versions
