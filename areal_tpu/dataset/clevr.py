@@ -38,7 +38,9 @@ def get_clevr_count_dataset(
             if not line.strip():
                 continue
             row = json.loads(line)
-            images = row.get("images") or [row["image"]]
+            images = row.get("images") or (
+                [row["image"]] if "image" in row else []
+            )
             images = [
                 img if not isinstance(img, str) or os.path.isabs(img)
                 else os.path.join(base, img)
@@ -54,6 +56,18 @@ def get_clevr_count_dataset(
                 sample["input_ids"] = row["input_ids"]
                 if max_length and len(sample["input_ids"]) > max_length:
                     continue
+            # pre-patchified manifests (offline processing, no AutoProcessor
+            # at train time): inline pixel patches + image grids ride along
+            if "pixel_values" in row:
+                import numpy as np
+
+                sample["pixel_values"] = np.asarray(
+                    row["pixel_values"], np.float32
+                )
+                sample["image_grid_thw"] = np.asarray(
+                    row["image_grid_thw"], np.int64
+                )
+                del sample["images"]
             samples.append(sample)
     return samples
 

@@ -52,8 +52,6 @@ class JaxVLMEngine(JaxTrainEngine):
 
     def initialize(self, addr=None, ft_spec=None) -> None:
         super().initialize(addr=addr, ft_spec=ft_spec)
-        if self.mesh.shape["sp"] != 1:
-            raise NotImplementedError("VLM engine v1 requires sp=1")
         if "vision" not in self.params:
             # scratch init of the tower when the checkpoint is text-only
             import jax
@@ -132,6 +130,11 @@ class JaxVLMEngine(JaxTrainEngine):
         pad_ids[: ids.shape[0]] = ids
         data["pixel_values"] = pad_pv
         data["patch_img_ids"] = pad_ids
+        if "patch_pos_hw" in batch:
+            pos = np.asarray(batch["patch_pos_hw"], np.int32)
+            pad_pos = np.zeros((N, 2), np.int32)
+            pad_pos[: pos.shape[0]] = pos
+            data["patch_pos_hw"] = pad_pos
         # per-row patch spans: the mb splitter needs them to carve patch
         # arrays along row-group boundaries
         if "patches_per_row" in batch:
@@ -168,9 +171,12 @@ class JaxVLMEngine(JaxTrainEngine):
         }
         out = super()._stack_mbs(data, n_mbs)
         pv, ids = vision["pixel_values"], vision["patch_img_ids"]
+        pos = vision.get("patch_pos_hw")
         if n_mbs == 1:
             out["pixel_values"] = pv[None]
             out["patch_img_ids"] = ids[None]
+            if pos is not None:
+                out["patch_pos_hw"] = pos[None]
             return out
         spans = vision["patches_per_row"]
         R = spans.shape[0]
@@ -183,11 +189,16 @@ class JaxVLMEngine(JaxTrainEngine):
         pmax = ((pmax + dp_mult - 1) // dp_mult) * dp_mult
         pv_mb = np.zeros((n_mbs, pmax, pv.shape[1]), pv.dtype)
         ids_mb = np.full((n_mbs, pmax), -1, np.int32)
+        pos_mb = None if pos is None else np.zeros((n_mbs, pmax, 2), np.int32)
         for i, (l, h) in enumerate(zip(lo, hi)):
             pv_mb[i, : h - l] = pv[l:h]
             ids_mb[i, : h - l] = ids[l:h]
+            if pos_mb is not None:
+                pos_mb[i, : h - l] = pos[l:h]
         out["pixel_values"] = pv_mb
         out["patch_img_ids"] = ids_mb
+        if pos_mb is not None:
+            out["patch_pos_hw"] = pos_mb
         return out
 
     def _device_batch(self, data, stacked: bool):
@@ -228,6 +239,7 @@ class JaxVLMEngine(JaxTrainEngine):
             batch["pixel_values"],
             batch["patch_img_ids"],
             mrope_positions=mrope,
+            patch_pos_hw=batch.get("patch_pos_hw"),
             mesh=self.mesh,
         )
 
@@ -235,23 +247,20 @@ class JaxVLMEngine(JaxTrainEngine):
 class VLMPPOActor:
     """GRPO actor for the VLM engine.
 
-    Thin delegation instead of a PPOActor subclass: the generic minibatch
-    split (select_rows over B) would slice pixel tensors — whose leading dim
-    is patches, not sequences — so the update runs as ONE engine
-    train_batch over the full batch (ppo_n_minibatches=1 enforced), with
-    vision keys carried through intact.  Advantage/logp computation is
-    inherited behavior via composition with the standard PPOActor.
+    Thin delegation instead of a PPOActor subclass: advantage/logp
+    computation and loss/stat handling come from the standard PPOActor by
+    composition.  Where the base actor slices rows freely, vision batches
+    must carve patch arrays along per-row spans (`select_rows_vision`), so
+    this actor owns the minibatch split (contiguous row groups — order
+    preserved, pixels follow their sequences) and the dynamic-sampling
+    filter (span-aware row selection with image-id renumbering).
+    Reference: areal/engine/ppo/actor.py ppo_update (no VLM restrictions)
+    over base_hf_engine.py's VLM batches.
     """
 
     def __init__(self, config, engine: JaxVLMEngine):
         from areal_tpu.engine.ppo.actor import PPOActor
 
-        if config.ppo_n_minibatches != 1:
-            raise NotImplementedError("VLM GRPO v1: set ppo_n_minibatches=1")
-        if config.dynamic_sampling:
-            raise NotImplementedError(
-                "dynamic sampling reorders sequences away from their pixels"
-            )
         self._ppo = PPOActor(config, engine)
         self.config = config
         self.engine = engine
@@ -262,14 +271,40 @@ class VLMPPOActor:
     def compute_advantages(self, batch):
         self._ppo.compute_advantages(batch)
 
+    def flush_stats(self):
+        self._ppo.flush_stats()
+
     def ppo_update(self, batch):
+        from areal_tpu.utils.data import select_rows_vision
+
+        cfg = self.config
         keys = self._ppo.LOSS_KEYS + VISION_KEYS + (
             "mrope_positions", "patches_per_row",
         )
         view = {k: batch[k] for k in keys if k in batch}
-        # loss construction, stat normalisation, and tracker commit are the
-        # base actor's — one source, no drift
-        return [self._ppo._train_one_mb(view)]
+        if cfg.dynamic_sampling:
+            keep = self._ppo._dynamic_filter(batch)  # needs "rewards"
+            if keep is not None:
+                view = select_rows_vision(view, keep)
+
+        n_mbs = max(1, cfg.ppo_n_minibatches)
+        B = view["input_ids"].shape[0]
+        n_mbs = min(n_mbs, B)
+        if n_mbs > 1 and "patches_per_row" not in view:
+            raise ValueError(
+                "ppo_n_minibatches>1 on a vision batch needs "
+                "'patches_per_row' (emitted by VisionRLVRWorkflow)"
+            )
+        # contiguous row groups (not FFD-shuffled like the text path): patch
+        # arrays are carved by span, and scan order must keep matching
+        # placeholder order inside each minibatch
+        edges = np.linspace(0, B, n_mbs + 1).astype(np.int64)
+        all_stats = []
+        for i in range(n_mbs):
+            rows = np.arange(edges[i], edges[i + 1])
+            mb = select_rows_vision(view, rows) if n_mbs > 1 else view
+            all_stats.append(self._ppo._train_one_mb(mb))
+        return all_stats
 
 
 class JaxVLMPPOActor(JaxVLMEngine):
@@ -287,3 +322,6 @@ class JaxVLMPPOActor(JaxVLMEngine):
 
     def ppo_update(self, batch):
         return self.actor.ppo_update(batch)
+
+    def flush_stats(self):
+        self.actor.flush_stats()

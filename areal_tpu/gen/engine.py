@@ -99,6 +99,7 @@ class GenEngine:
         seed: int = 0,
         decode_chunk: int = 8,
         tp: int = 1,
+        ep: int = 1,
         devices=None,
     ):
         self.model_config = model_config.replace(remat=False)
@@ -112,15 +113,27 @@ class GenEngine:
             else:
                 params = init_params(self.model_config, jax.random.PRNGKey(seed))
         self.tp = tp
+        self.ep = ep
         if tp > 1 and self.model_config.num_kv_heads % tp != 0:
             raise ValueError(
                 f"tp={tp} must divide num_kv_heads="
                 f"{self.model_config.num_kv_heads} (kv-head-sharded cache)"
             )
-        # serving mesh: tensor parallel only — dp across servers is the
+        if ep > 1 and (
+            self.model_config.num_experts == 0
+            or self.model_config.num_experts % ep != 0
+        ):
+            raise ValueError(
+                f"ep={ep} needs a MoE model with num_experts divisible by it "
+                f"(num_experts={self.model_config.num_experts})"
+            )
+        # serving mesh: tensor + expert parallel — dp across servers is the
         # client's job (core/remote.py multi-server routing), so the mesh
-        # reuses the trainer's partition specs with dp=fsdp=sp=1
-        self.mesh = build_mesh(dp=1, fsdp=1, sp=1, tp=tp, devices=devices)
+        # reuses the trainer's partition specs with dp=fsdp=sp=1.  ep>1
+        # shards the [E, ., .] expert leaves (the reference's inference-side
+        # expert dims, alloc_mode.py:80-117); without it a large MoE's
+        # experts are replicated per server and don't fit.
+        self.mesh = build_mesh(dp=1, fsdp=1, sp=1, tp=tp, ep=ep, devices=devices)
         self._pspecs = param_partition_specs(self.model_config, tp=tp)
         if self.model_config.vision is not None:
             # VLM: materialise a scratch tower if the checkpoint lacks one
@@ -235,8 +248,10 @@ class GenEngine:
 
         vcfg = cfg.vision
 
-        def _embed_images(vparams, pv, img_ids):
-            return vision_forward(vparams, vcfg, pv, img_ids)
+        def _embed_images(vparams, pv, img_ids, pos_hw):
+            return vision_forward(
+                vparams, vcfg, pv, img_ids, patch_pos_hw=pos_hw
+            )
 
         def _vlm_prefill(
             params, cache, ids, mpos, image_embeds, plen, slot_ids,
@@ -522,10 +537,18 @@ class GenEngine:
                 img_ids[ofs : ofs + n] = gid
                 ofs += n
                 gid += 1
+        from areal_tpu.models.vision import vision_rot_pos_ids
+
+        pos_hw = np.zeros((n_pad, 2), np.int32)
+        real_pos = vision_rot_pos_ids(
+            np.concatenate(grids), cfg.vision.spatial_merge_size
+        )
+        pos_hw[: real_pos.shape[0]] = real_pos
         embeds = self._embed_images_fn(
             self.params["vision"],
             jnp.asarray(pv_pad, jnp.dtype(cfg.dtype)),
             jnp.asarray(img_ids),
+            jnp.asarray(pos_hw),
         )
         self.rng, sub = jax.random.split(self.rng)
         toks, logps, self.cache = self._vlm_prefill_fn(

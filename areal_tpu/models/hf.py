@@ -45,19 +45,40 @@ _LAYER_MAP = {
     "post_attention_layernorm.weight": (("post_attn_norm",), False),
 }
 
-# vision tower (models/vision.py tree) <-> "visual."-prefixed names, the
-# qwen2-VL naming convention; weights store [in, out], HF linears [out, in]
+# vision tower (models/vision.py tree) <-> "visual."-prefixed names in the
+# REAL Qwen2.5-VL checkpoint convention (RMSNorm norm1/norm2, biased
+# qkv/proj + gated mlp, merger.ln_q + merger.mlp.{0,2}); weights store
+# [in, out], HF linears [out, in].  patch_embed.proj is a Conv3d
+# [D, C, tps, ps, ps] reshaped to the tower's [patch_dim, D] matmul.
 _VISION_RE = re.compile(r"visual\.blocks\.(\d+)\.(.+)")
 _VISION_LAYER_MAP = {
     "norm1.weight": (("input_norm",), False),
     "attn.qkv.weight": (("wqkv",), True),
+    "attn.qkv.bias": (("b_qkv",), False),
     "attn.proj.weight": (("wo",), True),
+    "attn.proj.bias": (("b_o",), False),
     "norm2.weight": (("post_attn_norm",), False),
+    "mlp.up_proj.weight": (("w_up",), True),
+    "mlp.up_proj.bias": (("b_up",), False),
+    "mlp.gate_proj.weight": (("w_gate",), True),
+    "mlp.gate_proj.bias": (("b_gate",), False),
+    "mlp.down_proj.weight": (("w_down",), True),
+    "mlp.down_proj.bias": (("b_down",), False),
+}
+# read-only aliases: this repo's pre-r3 checkpoints used short mlp names
+_VISION_LAYER_ALIASES = {
     "mlp.up.weight": (("w_up",), True),
     "mlp.gate.weight": (("w_gate",), True),
     "mlp.down.weight": (("w_down",), True),
 }
 _VISION_TOP_MAP = {  # name -> (key, transpose)
+    "visual.merger.ln_q.weight": ("merger_norm", False),
+    "visual.merger.mlp.0.weight": ("merger_fc1", True),
+    "visual.merger.mlp.0.bias": ("merger_fc1_b", False),
+    "visual.merger.mlp.2.weight": ("merger_fc2", True),
+    "visual.merger.mlp.2.bias": ("merger_fc2_b", False),
+}
+_VISION_TOP_ALIASES = {
     "visual.patch_embed.weight": ("patch_embed", False),
     "visual.merger.ln.weight": ("merger_norm", False),
     "visual.merger.fc1.weight": ("merger_fc1", True),
@@ -133,6 +154,11 @@ def state_to_params(
     seen_head = False
     for name, arr in items:
         arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; astype below handles it
+        # newer transformers nest the decoder/tower under model.*
+        if name.startswith("model.language_model."):
+            name = "model." + name[len("model.language_model."):]
+        elif name.startswith("model.visual."):
+            name = name[len("model."):]
         if name.startswith("visual."):
             if cfg.vision is None:
                 logger.warning("skipping vision weight %s (text-only config)", name)
@@ -140,17 +166,25 @@ def state_to_params(
             vm = _VISION_RE.match(name)
             if vm:
                 idx, suffix = int(vm.group(1)), vm.group(2)
-                if suffix not in _VISION_LAYER_MAP:
+                entry = _VISION_LAYER_MAP.get(suffix) or _VISION_LAYER_ALIASES.get(suffix)
+                if entry is None:
                     logger.warning("skipping unmapped weight %s", name)
                     continue
-                path_in_layer, transpose = _VISION_LAYER_MAP[suffix]
+                path_in_layer, transpose = entry
                 if transpose:
                     arr = arr.T
                 buf = vision_layer_buf(path_in_layer, arr.shape)
                 buf[idx] = arr.astype(np_dtype)
                 vision_fill[path_in_layer] = vision_fill.get(path_in_layer, 0) + 1
-            elif name in _VISION_TOP_MAP:
-                key, transpose = _VISION_TOP_MAP[name]
+            elif name == "visual.patch_embed.proj.weight":
+                # Conv3d [D, C, tps, ps, ps] -> matmul [patch_dim, D]
+                vision["patch_embed"] = (
+                    arr.reshape(arr.shape[0], -1).T.astype(np_dtype)
+                )
+            elif name in _VISION_TOP_MAP or name in _VISION_TOP_ALIASES:
+                key, transpose = (
+                    _VISION_TOP_MAP.get(name) or _VISION_TOP_ALIASES[name]
+                )
                 vision[key] = (arr.T if transpose else arr).astype(np_dtype)
             else:
                 logger.warning("skipping unmapped weight %s", name)
@@ -190,16 +224,28 @@ def state_to_params(
     if not cfg.tie_word_embeddings and not seen_head:
         raise ValueError("untied config but checkpoint has no lm_head.weight")
     if vision_fill or "patch_embed" in vision:
-        for path_in_layer, n in vision_fill.items():
-            if n != Lv:
-                raise ValueError(
-                    f"incomplete vision weights: {'.'.join(path_in_layer)} "
-                    f"filled for {n}/{Lv} layers"
-                )
-        for required in ("patch_embed", "merger_norm", "merger_fc1", "merger_fc2"):
-            if required not in vision:
-                raise ValueError(f"checkpoint missing visual {required}")
-        params["vision"] = vision
+        problems = [
+            f"{'.'.join(p)} filled {n}/{Lv} layers"
+            for p, n in vision_fill.items()
+            if n != Lv
+        ] + [
+            f"missing visual {req}"
+            for req in ("patch_embed", "merger_norm", "merger_fc1", "merger_fc2")
+            if req not in vision
+        ]
+        if problems:
+            # unmappable tower (e.g. Qwen2-VL's LayerNorm/fc1-fc2 blocks vs
+            # this tree's RMSNorm/gated layout): degrade to a text-only
+            # load — the text weights are still valuable — instead of
+            # failing the whole checkpoint.  (Unmapped EXTRA visual leaves
+            # alone are not fatal: the tower loads if its own tree filled.)
+            logger.warning(
+                "visual.* tree unmappable (%s); loading TEXT-ONLY — the "
+                "vision tower will be randomly initialised",
+                "; ".join(problems),
+            )
+        else:
+            params["vision"] = vision
     return params
 
 
@@ -238,12 +284,30 @@ def params_to_hf_state(
         raise ValueError("untied config but params have no lm_head")
     if "vision" in params and cfg.vision is not None:
         vision = params["vision"]
+        vc = cfg.vision
+        # [patch_dim, D] matmul -> Conv3d [D, C, tps, ps, ps] (the real
+        # Qwen2.5-VL layout, so transformers can load our checkpoints)
+        yield (
+            "visual.patch_embed.proj.weight",
+            np.ascontiguousarray(np.asarray(vision["patch_embed"]).T).reshape(
+                vc.hidden_size,
+                vc.in_channels,
+                vc.temporal_patch_size,
+                vc.patch_size,
+                vc.patch_size,
+            ),
+        )
         for name, (key, transpose) in _VISION_TOP_MAP.items():
+            if key not in vision:
+                continue  # pre-r3 trees carry no merger biases
             arr = np.asarray(vision[key])
             yield name, arr.T if transpose else arr
         for i in range(cfg.vision.num_layers):
             for suffix, (path_in_layer, transpose) in _VISION_LAYER_MAP.items():
-                buf = _get_nested(vision["layers"], path_in_layer)
+                try:
+                    buf = _get_nested(vision["layers"], path_in_layer)
+                except KeyError:
+                    continue  # pre-r3 trees carry no block biases
                 arr = np.asarray(buf[i])
                 yield (
                     f"visual.blocks.{i}.{suffix}",

@@ -53,41 +53,118 @@ def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> Params:
         ).astype(dtype)
 
     L = cfg.num_layers
+    # layout mirrors Qwen2.5-VL's tower (RMSNorm blocks, biased qkv/proj and
+    # gated mlp, biased 2-layer merger) so real checkpoints map 1:1
     return {
         "patch_embed": init(k[0], cfg.patch_dim, D),
         "layers": {
             "input_norm": jnp.ones((L, D), dtype),
             "wqkv": init(k[1], L, D, 3 * D) * np.sqrt(1.0 / 3),
+            "b_qkv": jnp.zeros((L, 3 * D), dtype),
             "wo": init(k[2], L, D, D),
+            "b_o": jnp.zeros((L, D), dtype),
             "post_attn_norm": jnp.ones((L, D), dtype),
             "w_up": init(k[3], L, D, I),
+            "b_up": jnp.zeros((L, I), dtype),
             "w_gate": init(k[4], L, D, I),
+            "b_gate": jnp.zeros((L, I), dtype),
             "w_down": init(k[5], L, I, D),
+            "b_down": jnp.zeros((L, D), dtype),
         },
         "merger_norm": jnp.ones((D,), dtype),
         "merger_fc1": init(k[6], merged, merged),
+        "merger_fc1_b": jnp.zeros((merged,), dtype),
         "merger_fc2": init(k[7], merged, cfg.out_hidden_size),
+        "merger_fc2_b": jnp.zeros((cfg.out_hidden_size,), dtype),
     }
 
 
-def _vit_layer(cfg: VisionConfig, lp: Params, x: jax.Array, img_ids: jax.Array):
+def vision_rot_pos_ids(
+    image_grid_thw: np.ndarray,  # int [n_img, 3] (t, h, w) in patches
+    spatial_merge_size: int = 2,
+) -> np.ndarray:
+    """Host-side per-patch (h, w) rotary coordinates [N, 2] in the
+    processor's patch order (merge-window-major — Qwen2-VL's
+    `rot_pos_emb` layout: h/w grids reshaped to (h/m, m, w/m, m) and
+    transposed so each merge window's m*m patches are consecutive)."""
+    out = []
+    m = spatial_merge_size
+    for t, h, w in np.asarray(image_grid_thw, np.int64):
+        hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+        wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+        hpos = hpos.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        wpos = wpos.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        hw = np.stack([hpos, wpos], axis=-1)
+        out.append(np.tile(hw, (int(t), 1)))
+    if not out:
+        return np.zeros((0, 2), np.int32)
+    return np.concatenate(out).astype(np.int32)
+
+
+def _vision_rope_angles(cfg: VisionConfig, patch_pos_hw: jax.Array) -> jax.Array:
+    """[N, 2] (h, w) coords -> rotary angles [N, head_dim/2]: the first
+    half of the frequency bands rotate by the h coordinate, the second by
+    w (Qwen2-VL VisionRotaryEmbedding: per-axis embeddings of dim hd/4
+    concatenated)."""
+    quarter = cfg.head_dim // 4
+    inv_freq = 1.0 / (
+        10000.0 ** (jnp.arange(0, quarter, dtype=jnp.float32) / quarter)
+    )
+    pos = patch_pos_hw.astype(jnp.float32)  # [N, 2]
+    angles = pos[:, :, None] * inv_freq[None, None, :]  # [N, 2, hd/4]
+    return angles.reshape(pos.shape[0], -1)  # [N, hd/2]
+
+
+def _apply_vision_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [N, H, hd]; rotate_half convention with angles [N, hd/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(
+        x.dtype
+    )
+
+
+def _vit_layer(
+    cfg: VisionConfig,
+    lp: Params,
+    x: jax.Array,
+    img_ids: jax.Array,
+    rope: Optional[Tuple[jax.Array, jax.Array]] = None,  # (cos, sin) [N, hd/2]
+):
     """One bidirectional block over [N, D] patches; attention only within
     the same image (img_ids [N], -1 = padding)."""
     N, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-    qkv = (h @ lp["wqkv"].astype(x.dtype)).reshape(N, 3, H, hd)
+    qkv = h @ lp["wqkv"].astype(x.dtype)
+    if "b_qkv" in lp:
+        qkv = qkv + lp["b_qkv"].astype(x.dtype)
+    qkv = qkv.reshape(N, 3, H, hd)
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    if rope is not None:
+        q = _apply_vision_rope(q, *rope)
+        k = _apply_vision_rope(k, *rope)
     scores = jnp.einsum("nhd,mhd->hnm", q, k).astype(jnp.float32) / np.sqrt(hd)
     mask = (img_ids[:, None] == img_ids[None, :]) & (img_ids[:, None] >= 0)
     scores = jnp.where(mask[None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("hnm,mhd->nhd", probs, v).reshape(N, D)
-    x = x + attn @ lp["wo"].astype(x.dtype)
+    proj = attn @ lp["wo"].astype(x.dtype)
+    if "b_o" in lp:
+        proj = proj + lp["b_o"].astype(x.dtype)
+    x = x + proj
     h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     up = h @ lp["w_up"].astype(x.dtype)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(x.dtype))
-    return x + (up * gate) @ lp["w_down"].astype(x.dtype)
+    gate = h @ lp["w_gate"].astype(x.dtype)
+    if "b_up" in lp:
+        up = up + lp["b_up"].astype(x.dtype)
+        gate = gate + lp["b_gate"].astype(x.dtype)
+    out = (up * jax.nn.silu(gate)) @ lp["w_down"].astype(x.dtype)
+    if "b_down" in lp:
+        out = out + lp["b_down"].astype(x.dtype)
+    return x + out
 
 
 def vision_forward(
@@ -95,24 +172,42 @@ def vision_forward(
     cfg: VisionConfig,
     pixel_values: jax.Array,  # [N, patch_dim] pre-patchified
     img_ids: jax.Array,  # int32 [N]: image index per patch, -1 padding
+    patch_pos_hw: Optional[jax.Array] = None,  # int [N, 2] rotary coords
 ) -> jax.Array:
     """-> merged embeddings [N // merge^2, out_hidden_size].
 
     Patches must arrive row-major per image with h, w divisible by the
     merge size (the qwen2-VL processor guarantees this), so consecutive
-    groups of merge^2 patches form one output embedding."""
+    groups of merge^2 patches form one output embedding.
+
+    `patch_pos_hw` (vision_rot_pos_ids) enables the 2D rotary embedding —
+    without it the tower is permutation-blind to spatial layout within an
+    image (legacy batches; spatial signal then comes only from merge
+    grouping + decoder mrope).  Blocks attend across each whole image
+    (Qwen2-VL full attention; 2.5-VL's windowed layers are approximated by
+    full attention — a superset receptive field)."""
     dtype = pixel_values.dtype
     x = pixel_values @ params["patch_embed"].astype(dtype)
+    rope = None
+    if patch_pos_hw is not None:
+        angles = _vision_rope_angles(cfg, patch_pos_hw)
+        rope = (jnp.cos(angles), jnp.sin(angles))
 
     def body(x, lp):
-        return _vit_layer(cfg, lp, x, img_ids), None
+        return _vit_layer(cfg, lp, x, img_ids, rope=rope), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["merger_norm"], cfg.rms_norm_eps)
     m2 = cfg.spatial_merge_size**2
     x = x.reshape(x.shape[0] // m2, m2 * cfg.hidden_size)
-    x = jax.nn.gelu(x @ params["merger_fc1"].astype(dtype))
-    return x @ params["merger_fc2"].astype(dtype)
+    h1 = x @ params["merger_fc1"].astype(dtype)
+    if "merger_fc1_b" in params:
+        h1 = h1 + params["merger_fc1_b"].astype(dtype)
+    # exact (erf) gelu: HF's nn.GELU default, not the tanh approximation
+    out = jax.nn.gelu(h1, approximate=False) @ params["merger_fc2"].astype(dtype)
+    if "merger_fc2_b" in params:
+        out = out + params["merger_fc2_b"].astype(dtype)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -223,6 +318,7 @@ def forward_vlm_lm(
     pixel_values: jax.Array,  # [N, patch_dim]
     patch_img_ids: jax.Array,  # [N] image index per patch (-1 pad)
     mrope_positions: Optional[jax.Array] = None,  # [3, B, T]
+    patch_pos_hw: Optional[jax.Array] = None,  # [N, 2] 2D rotary coords
     mesh=None,
 ) -> LMOutput:
     """VLM forward with deferred LM head (mirrors transformer.forward_lm)."""
@@ -230,7 +326,8 @@ def forward_vlm_lm(
     dtype = jnp.dtype(cfg.dtype)
     text = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
     vis = vision_forward(
-        params["vision"], cfg.vision, pixel_values.astype(dtype), patch_img_ids
+        params["vision"], cfg.vision, pixel_values.astype(dtype),
+        patch_img_ids, patch_pos_hw=patch_pos_hw,
     )
     x = merge_image_embeds(text, input_ids, vis, cfg.image_token_id)
     rope = None

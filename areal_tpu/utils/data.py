@@ -31,7 +31,7 @@ def _is_per_token(key: str, arr: np.ndarray, batch: int, seqlen: int) -> bool:
 # vision batch keys indexed by PATCH (not row) plus the per-row span
 # metadata that lets row-wise splitters carve them — the ONE list the
 # controller, the batch container, and the VLM engine all share
-VISION_PATCH_KEYS = ("pixel_values", "patch_img_ids")
+VISION_PATCH_KEYS = ("pixel_values", "patch_img_ids", "patch_pos_hw")
 VISION_BATCH_KEYS = VISION_PATCH_KEYS + ("patches_per_row",)
 
 
@@ -130,6 +130,56 @@ def select_rows(batch: Dict[str, np.ndarray], idx: Sequence[int]) -> Dict[str, n
     idx = np.asarray(idx, dtype=np.int64)
     return {k: v[idx] if isinstance(v, np.ndarray) and v.ndim >= 1 else v
             for k, v in batch.items()}
+
+
+def select_rows_vision(
+    batch: Dict[str, np.ndarray], idx: Sequence[int]
+) -> Dict[str, np.ndarray]:
+    """`select_rows` for batches carrying vision keys.
+
+    Patch arrays (`pixel_values`, `patch_img_ids`) are indexed by PATCH, not
+    row: naive row slicing would tear pixels away from their sequences (the
+    reason the v1 VLM actor forbade dynamic sampling / minibatching).  Using
+    the per-row spans (`patches_per_row`, emitted by VisionRLVRWorkflow) the
+    selected rows' patch ranges are gathered in the new row order and the
+    per-patch image indices renumbered by first appearance, preserving the
+    scan-order invariant `forward_vlm_lm` matches embeddings by.
+    """
+    idx = np.asarray(idx, dtype=np.int64)
+    token = {k: v for k, v in batch.items() if k not in VISION_BATCH_KEYS}
+    out = select_rows(token, idx)
+    if "pixel_values" not in batch:
+        return out
+    if "patches_per_row" not in batch:
+        raise ValueError(
+            "row selection on a vision batch needs 'patches_per_row'"
+        )
+    spans = np.asarray(batch["patches_per_row"], np.int64)
+    bounds = np.concatenate([[0], np.cumsum(spans)])
+    patch_idx = (
+        np.concatenate(
+            [np.arange(bounds[i], bounds[i + 1]) for i in idx]
+        ).astype(np.int64)
+        if len(idx)
+        else np.zeros(0, np.int64)
+    )
+    ids = np.asarray(batch["patch_img_ids"])[patch_idx]
+    # renumber image indices by first appearance = new scan order
+    new_ids = np.full(ids.shape, -1, np.int32)
+    real = ids >= 0
+    if real.any():
+        _, first_pos, inverse = np.unique(
+            ids[real], return_index=True, return_inverse=True
+        )
+        order = np.empty(first_pos.shape[0], np.int64)
+        order[np.argsort(first_pos)] = np.arange(first_pos.shape[0])
+        new_ids[real] = order[inverse].astype(np.int32)
+    for k in VISION_PATCH_KEYS:
+        if k in batch:
+            out[k] = np.asarray(batch[k])[patch_idx]
+    out["patch_img_ids"] = new_ids
+    out["patches_per_row"] = spans[idx]
+    return out
 
 
 def batch_size(batch: Dict[str, np.ndarray]) -> int:

@@ -160,6 +160,11 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         batch["patch_img_ids"] = np.concatenate(
             [ids_one + r * n_img for r in range(n_samples)]
         ).astype(np.int32)
+        # per-patch (h, w) rotary coords for the tower's 2D rope
+        from areal_tpu.models.vision import vision_rot_pos_ids
+
+        pos_one = vision_rot_pos_ids(grid, self.spatial_merge_size)
+        batch["patch_pos_hw"] = np.tile(pos_one, (n_samples, 1))
         # per-row patch counts: the metadata that lets row-wise splitters
         # (controller dp fan-out, micro-batching) carve the patch arrays
         # consistently with the rows

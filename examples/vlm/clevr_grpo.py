@@ -1,0 +1,253 @@
+"""CLEVR-count GRPO — vision-language RL on the VLM engine.
+
+Behavioral counterpart of the reference's
+`examples/vlm/clevr_count_70k_grpo.py`: a Qwen2-VL-class model learns to
+count objects in CLEVR scenes with a binary counting reward.  Same loop
+shape as examples/math/gsm8k_grpo.py, with the VLM swaps:
+
+- dataset type "clevr" (areal_tpu/dataset/clevr.py) — jsonl manifest with
+  image paths + counting questions (offline-friendly);
+- VisionRLVRWorkflow: AutoProcessor patchifies images, pixels ride to the
+  native VLM server (gen/server.py pixel wire fields) and back into the
+  train batch with mrope positions;
+- JaxVLMPPOActor: vision tower + mrope decoder training, patch-span-aware
+  minibatching and dynamic sampling (engine/vlm_engine.py).
+
+Launch:  python examples/vlm/clevr_grpo.py --config examples/vlm/clevr_grpo.yaml
+(or via the launcher, which also starts a generation server:
+ python -m areal_tpu.launcher.local examples/vlm/clevr_grpo.py --config ...)
+"""
+
+import copy
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.dataset.clevr import clevr_count_reward
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.engine.vlm_engine import JaxVLMPPOActor
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.evaluator import Evaluator
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
+
+logger = logging.getLogger("clevr_grpo")
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, GRPOConfig)
+    seeding.set_random_seed(config.seed, "trainer")
+
+    tokenizer = processor = None
+    if config.tokenizer_path:
+        from transformers import AutoProcessor, AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(config.tokenizer_path)
+        try:
+            processor = AutoProcessor.from_pretrained(config.tokenizer_path)
+        except Exception:  # noqa: BLE001 — pre-tokenized manifests need none
+            logger.warning("no AutoProcessor at %s; expecting pre-tokenized "
+                           "manifest rows", config.tokenizer_path)
+
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type or "clevr",
+        split="train",
+        tokenizer=tokenizer,
+        processor=processor,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    rollout = RemoteJaxEngine(config.rollout)
+    rollout.initialize(train_data_parallel_size=1)
+    eval_rollout = RemoteJaxEngine(copy.deepcopy(config.rollout))
+    eval_rollout.config.max_head_offpolicyness = int(1e12)
+    eval_rollout.initialize(train_data_parallel_size=1)
+
+    valid_dataset = get_custom_dataset(
+        path=config.valid_dataset.path,
+        type=config.valid_dataset.type or "clevr",
+        split="test",
+        tokenizer=tokenizer,
+        processor=processor,
+        max_length=config.valid_dataset.max_length,
+    ) if config.valid_dataset is not None else None
+
+    # the VLM actor needs the full (text + vision) model config up front
+    model_config = TransformerConfig.from_hf(config.actor.path)
+    if model_config.vision is None:
+        raise ValueError(
+            f"{config.actor.path} has no vision_config — clevr_grpo needs a "
+            "Qwen2-VL-class checkpoint"
+        )
+    actor = JaxVLMPPOActor(config.actor, model_config=model_config)
+    actor.create_process_group()
+    actor.initialize(ft_spec=ft_spec)
+
+    if config.weight_update_mode == "transfer":
+        weight_meta = WeightUpdateMeta.from_transfer(
+            config.experiment_name, config.trial_name
+        )
+    else:
+        weight_meta = WeightUpdateMeta.from_disk(
+            config.experiment_name, config.trial_name, config.cluster.fileroot
+        )
+
+    from areal_tpu.api.reward import prewarm_reward_pool
+
+    prewarm_reward_pool()
+    spatial_merge = (
+        model_config.vision.spatial_merge_size if model_config.vision else 2
+    )
+    workflow = VisionRLVRWorkflow(
+        reward_fn=clevr_count_reward,
+        gconfig=config.gconfig,
+        tokenizer=tokenizer,
+        processor=processor,
+        image_token_id=model_config.image_token_id,
+        spatial_merge_size=spatial_merge,
+        dump_dir=os.path.join(
+            StatsLogger.get_log_path(config.stats_logger), "generated"
+        ),
+    )
+    eval_workflow = VisionRLVRWorkflow(
+        reward_fn=clevr_count_reward,
+        gconfig=config.gconfig.new(n_samples=1, temperature=0.0),
+        tokenizer=tokenizer,
+        processor=processor,
+        image_token_id=model_config.image_token_id,
+        spatial_merge_size=spatial_merge,
+        rollout_stat_scope="eval-rollout",
+        dump_dir=os.path.join(
+            StatsLogger.get_log_path(config.stats_logger), "generated-eval"
+        ),
+    )
+
+    saver = Saver(config.saver, ft_spec)
+    checkpointer = Saver(config.checkpointer, ft_spec, for_recover=True)
+    evaluator = Evaluator(config.evaluator, ft_spec)
+    stats_logger = StatsLogger(config.stats_logger)
+    recover = RecoverHandler(config.recover, ft_spec)
+
+    start_step = 0
+    if check_if_recover(config.recover, run_id=int(os.environ.get("AREAL_RUN_ID", 0))):
+        info = recover.load(
+            actor,
+            saver=saver,
+            evaluator=evaluator,
+            stats_logger=stats_logger,
+            dataloader=dataloader,
+            inference_engine=rollout,
+            weight_update_meta=weight_meta,
+        )
+        if info is not None:
+            start_step = info.recover_start.global_step
+
+    total_steps = config.total_train_steps or ft_spec.total_train_steps
+    steps_per_epoch = ft_spec.steps_per_epoch
+
+    def iter_or_cycle(dl):
+        while True:
+            yield from dl
+
+    for global_step in range(start_step, total_steps):
+        epoch = global_step // steps_per_epoch
+        epoch_step = global_step % steps_per_epoch
+        step_info = StepInfo(
+            epoch=epoch, epoch_step=epoch_step, global_step=global_step,
+            steps_per_epoch=steps_per_epoch,
+        )
+
+        with stats.record_timing("rollout"):
+            if config.async_training:
+                batch = rollout.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = rollout.rollout_batch(
+                    next(iter_or_cycle(dataloader)), workflow=workflow
+                )
+
+        if config.actor.recompute_logprob:
+            with stats.record_timing("recompute_logp"):
+                batch["prox_logp"] = actor.compute_logp(batch)
+
+        with stats.record_timing("compute_advantages"):
+            actor.compute_advantages(batch)
+
+        with stats.record_timing("ppo_update"):
+            train_stats = actor.ppo_update(batch)
+            actor.step_lr_scheduler()
+
+        with stats.record_timing("update_weights"):
+            rollout.pause()
+            actor.set_version(global_step + 1)
+            actor.update_weights(weight_meta)
+            rollout.update_weights(weight_meta)
+            rollout.set_version(global_step + 1)
+            eval_rollout.set_version(global_step + 1)
+            rollout.resume()
+
+        with stats.record_timing("save_eval"):
+            saver.save(actor, epoch, epoch_step, global_step, tokenizer=tokenizer)
+            if checkpointer.freq.check(epoch, global_step):
+                recover.dump(
+                    actor, step_info, saver=saver, evaluator=evaluator,
+                    stats_logger=stats_logger, dataloader=dataloader,
+                    tokenizer=tokenizer,
+                )
+
+        with stats.record_timing("eval"):
+            def evaluate_fn():
+                if valid_dataset is None:
+                    return None
+                eval_batch = eval_rollout.rollout_batch(
+                    list(valid_dataset), workflow=eval_workflow
+                )
+                rew = np.asarray(eval_batch["rewards"], np.float32)
+                result = {"eval_reward_mean": float(rew.mean()),
+                          "eval_n": int(rew.size)}
+                stats.scalar(**result)
+                return result
+
+            evaluator.evaluate(evaluate_fn, epoch, epoch_step, global_step)
+
+        actor.flush_stats()
+        reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
+        stats.scalar(reward=reward_mean, n_seqs=len(batch.get("rewards", [])))
+        stats_logger.commit(
+            epoch, epoch_step, global_step,
+            [stats.export()] + train_stats,
+        )
+        logger.info(
+            f"Epoch {epoch + 1}/{config.total_train_epochs} "
+            f"Step {epoch_step + 1}/{steps_per_epoch} "
+            f"(global {global_step + 1}/{total_steps}) done."
+        )
+
+    stats_logger.close()
+    eval_rollout.destroy()
+    rollout.destroy()
+    actor.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

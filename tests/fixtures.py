@@ -81,3 +81,67 @@ def make_gsm8k_jsonl(path: str, n: int = 32):
         for r in rows:
             f.write(json.dumps(r) + "\n")
     return path
+
+
+def make_tiny_vlm_ckpt(out_dir: str, vocab_size: int = 384, seed: int = 0):
+    """Tiny Qwen2-VL-style checkpoint (text + vision tower + tokenizer)
+    loadable by TransformerConfig.from_hf + the train/serving engines."""
+    import jax
+
+    from areal_tpu.models import init_params
+    from areal_tpu.models.hf import save_hf_checkpoint
+    from areal_tpu.models.model_config import VisionConfig, tiny_config
+    from areal_tpu.models.vision import init_vision_params
+
+    tokenizer = make_tiny_tokenizer(out_dir, vocab_size=256)
+    image_token_id = 251  # inside the tokenizer vocab, unused by text
+    vcfg = VisionConfig(
+        patch_size=2,
+        temporal_patch_size=1,
+        in_channels=3,
+        hidden_size=16,
+        intermediate_size=32,
+        num_layers=1,
+        num_heads=2,
+        spatial_merge_size=2,
+        out_hidden_size=64,
+    )
+    cfg = tiny_config(
+        vocab_size=vocab_size,
+        qkv_bias=True,
+        hf_architecture="Qwen2VLForConditionalGeneration",
+        eos_token_id=tokenizer.eos_token_id,
+    ).replace(vision=vcfg, image_token_id=image_token_id,
+              mrope_section=(2, 3, 3))
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    params["vision"] = init_vision_params(vcfg, jax.random.PRNGKey(seed + 1))
+    save_hf_checkpoint(params, cfg, out_dir, save_dtype="float32")
+    return cfg
+
+
+def make_clevr_jsonl(path: str, cfg, n: int = 8, rng_seed: int = 0):
+    """Pre-patchified CLEVR-count manifest rows: input_ids with placeholder
+    runs, inline pixel patches, and the integer answer."""
+    import json
+
+    import numpy as np
+
+    vcfg = cfg.vision
+    rng = np.random.default_rng(rng_seed)
+    n_placeholder = 4  # 4x4 patches / merge 2x2
+    rows = []
+    for i in range(n):
+        ids = [5, 6 + (i % 7)] + [cfg.image_token_id] * n_placeholder + [20, 21]
+        rows.append({
+            "input_ids": ids,
+            "messages": f"How many objects? (scene {i})",
+            "answer": i % 5,
+            "pixel_values": rng.normal(
+                size=(16, vcfg.patch_dim)
+            ).astype(np.float32).round(3).tolist(),
+            "image_grid_thw": [[1, 4, 4]],
+        })
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return path
