@@ -1,5 +1,6 @@
 from areal_tpu.agent.api import Agent, AgentWorkflow, make_agent, register_agent
 from areal_tpu.agent.math_agent import MathMultiTurnAgent, MathSingleStepAgent
+from areal_tpu.agent.search_agent import SearchQAAgent
 from areal_tpu.agent.tir_agent import TIRMathAgent
 
 __all__ = [
@@ -9,5 +10,6 @@ __all__ = [
     "register_agent",
     "MathMultiTurnAgent",
     "MathSingleStepAgent",
+    "SearchQAAgent",
     "TIRMathAgent",
 ]

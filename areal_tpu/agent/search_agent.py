@@ -1,0 +1,54 @@
+"""Search-QA agent: interleaved retrieval-and-reasoning episodes.
+
+Capability counterpart of the reference's search-agent example
+(examples/search-agent + the ASearcher workflow it wires): the model emits
+`<search>query</search>` tags mid-generation; the agent runs the query
+against the episode's environment (`search` tool — LocalSearchEnv's BM25
+corpus here, a retrieval service in production), injects the hits back as
+an `<information>...</information>` block, and generation continues with
+the evidence in context.  Injected tokens carry loss_mask 0 / logprob 0 —
+the policy trains only on what it wrote (same convention as the TIR
+agent, whose generate→detect→execute→inject loop this class reuses).
+"""
+
+import re
+from typing import Optional
+
+from areal_tpu.agent.api import register_agent
+from areal_tpu.agent.tir_agent import TIRMathAgent
+from areal_tpu.api.config import GenerationHyperparameters
+
+_SEARCH_RE = re.compile(r"<search>(.*?)</search>", re.DOTALL)
+
+
+@register_agent("search-qa")
+class SearchQAAgent(TIRMathAgent):
+    def __init__(
+        self,
+        gconfig: GenerationHyperparameters,
+        tokenizer=None,
+        max_tool_calls: int = 4,
+        top_k: int = 3,
+        tool_output_chars: int = 2048,
+    ):
+        super().__init__(
+            gconfig,
+            tokenizer=tokenizer,
+            max_tool_calls=max_tool_calls,
+            tool_output_chars=tool_output_chars,
+        )
+        self.top_k = top_k
+
+    def _find_call(self, text: str):
+        m = _SEARCH_RE.search(text)
+        return (m.group(1), m.end()) if m else (None, None)
+
+    async def _run_tool(self, query: str, env=None) -> str:
+        if env is None:
+            hits: list = []
+        else:
+            hits, _, _ = await env.aexecute_tool(
+                "search", {"query": query.strip(), "k": self.top_k}
+            )
+        out = "\n".join(str(h) for h in hits)[: self.tool_output_chars]
+        return f"\n<information>\n{out}\n</information>\n"

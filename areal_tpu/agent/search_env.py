@@ -22,19 +22,15 @@ def _tokens(text: str) -> List[str]:
     return _TOKEN_RE.findall(text.lower())
 
 
-class LocalSearchEnv(Environment):
-    """`search(query, k)` returns the top-k corpus passages by a BM25-style
-    score; `verify_answer(completion)` grades the final answer."""
+class SearchIndex:
+    """BM25-lite index over a passage corpus.
 
-    def __init__(
-        self,
-        corpus: Sequence[str],
-        answer: str,
-        k1: float = 1.5,
-        b: float = 0.75,
-    ):
+    Built once and shared across episodes (datasets attach one index per
+    shared corpus — building tf/df tables per episode would pay O(corpus)
+    on the rollout event loop for every sample)."""
+
+    def __init__(self, corpus: Sequence[str], k1: float = 1.5, b: float = 0.75):
         self.corpus = list(corpus)
-        self.answer = str(answer)
         self._docs = [_tokens(d) for d in self.corpus]
         self._tfs = [Counter(toks) for toks in self._docs]
         self._df: Counter = Counter()
@@ -45,9 +41,6 @@ class LocalSearchEnv(Environment):
         )
         self.k1 = k1
         self.b = b
-        self.n_searches = 0
-
-    # ------------------------------------------------------------------
 
     def _score(self, query_toks: List[str], doc_idx: int) -> float:
         tf = self._tfs[doc_idx]
@@ -65,11 +58,34 @@ class LocalSearchEnv(Environment):
         return score
 
     def search(self, query: str, k: int = 3) -> List[str]:
-        self.n_searches += 1
         q = _tokens(query)
         scores = [self._score(q, i) for i in range(len(self.corpus))]
         ranked = sorted(range(len(scores)), key=scores.__getitem__, reverse=True)
         return [self.corpus[i] for i in ranked[:k] if scores[i] > 0]
+
+
+class LocalSearchEnv(Environment):
+    """`search(query, k)` returns the top-k corpus passages by a BM25-style
+    score; `verify_answer(completion)` grades the final answer."""
+
+    def __init__(
+        self,
+        corpus: Sequence[str],
+        answer: str,
+        k1: float = 1.5,
+        b: float = 0.75,
+        index: "SearchIndex" = None,
+    ):
+        self.index = index if index is not None else SearchIndex(corpus, k1, b)
+        self.corpus = self.index.corpus
+        self.answer = str(answer)
+        self.n_searches = 0
+
+    # ------------------------------------------------------------------
+
+    def search(self, query: str, k: int = 3) -> List[str]:
+        self.n_searches += 1
+        return self.index.search(query, k)
 
     # ------------------------------------------------------------------
 

@@ -55,6 +55,12 @@ class TIRMathAgent(Agent):
         self.tool_output_chars = tool_output_chars
 
     # ------------------------------------------------------------------
+    # The generate→find-tool-call→execute→inject loop below is shared with
+    # other tool agents (agent/search_agent.py) via these two hooks.
+
+    def _find_call(self, text: str):
+        """(payload, end_char_index) of the first complete tool call."""
+        return find_first_block(text)
 
     def _tokens_until(self, tokens: List[int], end_char: int) -> int:
         """Smallest k with len(decode(tokens[:k])) >= end_char — the token
@@ -69,7 +75,7 @@ class TIRMathAgent(Agent):
                 lo = mid + 1
         return lo
 
-    async def _run_tool(self, code: str) -> str:
+    async def _run_tool(self, code: str, env=None) -> str:
         from areal_tpu.reward.code_verifier import _run_sandboxed
 
         res = await asyncio.get_running_loop().run_in_executor(
@@ -102,7 +108,7 @@ class TIRMathAgent(Agent):
                 )
             )
             text = self.tokenizer.decode(resp.output_tokens)
-            code, end_char = find_first_block(text)
+            code, end_char = self._find_call(text)
             if code is not None and tool_calls >= self.max_tool_calls:
                 code = None  # cap reached: keep the text, skip execution
             if code is None:
@@ -121,7 +127,7 @@ class TIRMathAgent(Agent):
             versions += list(resp.output_versions[:k])
             budget -= k
             tool_calls += 1
-            tool_text = await self._run_tool(code)
+            tool_text = await self._run_tool(code, env)
             tool_ids = self.tokenizer.encode(tool_text, add_special_tokens=False)
             cur_version = versions[-1] if versions else 0
             ids += list(tool_ids)

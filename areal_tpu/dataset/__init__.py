@@ -38,3 +38,5 @@ from areal_tpu.dataset import clevr as _clevr  # noqa: E402,F401
 from areal_tpu.dataset import geometry3k as _geometry3k  # noqa: E402,F401
 from areal_tpu.dataset import hhrlhf as _hhrlhf  # noqa: E402,F401
 from areal_tpu.dataset import torl as _torl  # noqa: E402,F401
+from areal_tpu.dataset import countdown as _countdown  # noqa: E402,F401
+from areal_tpu.dataset import searchqa as _searchqa  # noqa: E402,F401

@@ -1,0 +1,92 @@
+"""Countdown arithmetic-game dataset.
+
+Capability counterpart of the reference's countdown example data
+(examples/countdown/countdown.py — synthetic (numbers, target) puzzles
+with a formula-verification reward).  Rows feed `AgentWorkflow` +
+`CountdownEnv` (agent/countdown_env.py) via the `workflow=countdown`
+entry-point branch.
+
+Two sources:
+- a jsonl manifest: {"numbers": [...], "target": N, "query_id"?: ...}
+- "synthetic[:N]" — N generated puzzles (default 256), each guaranteed
+  solvable by construction (the target is built from the numbers).
+"""
+
+import json
+import os
+import random
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+PROMPT = (
+    "Using the numbers {numbers}, create an arithmetic expression that "
+    "equals {target}. You may use +, -, *, / and each number at most "
+    "once. Show your reasoning, then give the final expression inside "
+    "\\boxed{{}}."
+)
+
+
+def _synthesize(n: int, seed: int, n_numbers: int = 4, lo: int = 1, hi: int = 25):
+    rng = random.Random(seed)
+    ops = [
+        ("+", lambda a, b: a + b),
+        ("-", lambda a, b: a - b),
+        ("*", lambda a, b: a * b),
+    ]
+    rows = []
+    for i in range(n):
+        numbers = [rng.randint(lo, hi) for _ in range(n_numbers)]
+        # build the target from a random expression over the numbers, so
+        # every puzzle is solvable
+        value = numbers[0]
+        for x in numbers[1:]:
+            _, fn = rng.choice(ops)
+            value = fn(value, x)
+        rows.append({"numbers": numbers, "target": value, "query_id": str(i)})
+    return rows
+
+
+@register_dataset("countdown")
+def get_countdown_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    if path.startswith("synthetic"):
+        n = int(path.split(":", 1)[1]) if ":" in path else 256
+        rows = _synthesize(n, seed=0 if split == "train" else 1)
+    else:
+        manifest = path
+        if os.path.isdir(path):
+            manifest = os.path.join(path, f"{split}.jsonl")
+        rows = []
+        with open(manifest) as f:
+            for i, line in enumerate(f):
+                if line.strip():
+                    row = json.loads(line)
+                    row.setdefault("query_id", str(i))
+                    rows.append(row)
+    samples = []
+    for row in rows:
+        prompt = PROMPT.format(
+            numbers=list(row["numbers"]), target=row["target"]
+        )
+        sample = {
+            "messages": [{"role": "user", "content": prompt}],
+            "numbers": list(row["numbers"]),
+            "target": row["target"],
+            "query_id": str(row["query_id"]),
+        }
+        if "input_ids" in row:
+            sample["input_ids"] = row["input_ids"]
+        elif tokenizer is not None and not hasattr(
+            tokenizer, "apply_chat_template"
+        ):
+            sample["input_ids"] = tokenizer.encode(prompt)
+        if max_length and "input_ids" in sample and len(sample["input_ids"]) > max_length:
+            continue
+        samples.append(sample)
+    return samples

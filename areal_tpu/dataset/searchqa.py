@@ -1,0 +1,89 @@
+"""Search-QA dataset: questions + answers over a retrieval corpus.
+
+Capability counterpart of the reference's search-agent example data
+(examples/search-agent/local_1.5b_example.yaml — QA pairs graded after
+retrieval).  Rows feed `AgentWorkflow` + `SearchQAAgent` +
+`LocalSearchEnv` via the `workflow=search` entry-point branch.
+
+Manifest layout (jsonl): {"question": ..., "answer": ...,
+"corpus"?: [...passages...]} — per-row corpora override the shared
+corpus file (`corpus.jsonl`/`corpus.txt` next to the manifest, one
+passage per line).
+"""
+
+import json
+import os
+from typing import Optional
+
+from areal_tpu.dataset import register_dataset
+
+PROMPT = (
+    "Answer the question below. You can search a reference corpus by "
+    "writing <search>your query</search>; results appear inside "
+    "<information> tags. When you know the answer, give it inside "
+    "\\boxed{{}}.\n\nQuestion: {question}"
+)
+
+
+def _load_corpus(base: str):
+    for name in ("corpus.jsonl", "corpus.txt"):
+        p = os.path.join(base, name)
+        if os.path.exists(p):
+            with open(p) as f:
+                lines = [ln.strip() for ln in f if ln.strip()]
+            if name.endswith(".jsonl"):
+                return [
+                    json.loads(ln).get("text", ln) if ln.startswith("{") else ln
+                    for ln in lines
+                ]
+            return lines
+    return []
+
+
+@register_dataset("searchqa")
+def get_searchqa_dataset(
+    path: str,
+    split: str = "train",
+    tokenizer=None,
+    max_length: Optional[int] = None,
+    **kwargs,
+):
+    from areal_tpu.agent.search_env import SearchIndex
+
+    manifest = path
+    if os.path.isdir(path):
+        manifest = os.path.join(path, f"{split}.jsonl")
+    base = os.path.dirname(os.path.abspath(manifest))
+    shared_corpus = _load_corpus(base)
+    # one BM25 index for the shared corpus: rows reference it via
+    # "_search_index" so envs never rebuild tf/df tables per episode
+    shared_index = SearchIndex(shared_corpus) if shared_corpus else None
+    samples = []
+    with open(manifest) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            prompt = PROMPT.format(question=row["question"])
+            sample = {
+                "messages": [{"role": "user", "content": prompt}],
+                "answer": str(row["answer"]),
+                "corpus": row.get("corpus", shared_corpus),
+                "query_id": str(row.get("query_id", i)),
+            }
+            if "corpus" not in row and shared_index is not None:
+                sample["_search_index"] = shared_index
+            if "input_ids" in row:
+                sample["input_ids"] = row["input_ids"]
+            elif tokenizer is not None and not hasattr(
+                tokenizer, "apply_chat_template"
+            ):
+                sample["input_ids"] = tokenizer.encode(prompt)
+            if (
+                max_length
+                and "input_ids" in sample
+                and len(sample["input_ids"]) > max_length
+            ):
+                continue
+            samples.append(sample)
+    return samples

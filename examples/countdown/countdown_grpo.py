@@ -1,0 +1,34 @@
+"""Countdown GRPO — arithmetic-game agent RL.
+
+Behavioral counterpart of the reference's `examples/countdown/train.py`:
+the model writes an arithmetic expression over given numbers to hit a
+target; `CountdownEnv` verifies the boxed formula (each number used at
+most once, exact value match).
+
+This entry point delegates to the shared GRPO loop
+(examples/math/gsm8k_grpo.py) with `workflow: countdown` — the loop,
+launcher wiring, weight sync, and recovery are identical across the
+agentic examples; only the dataset + workflow branch differ.
+
+Launch:  python examples/countdown/countdown_grpo.py --config examples/countdown/countdown_grpo.yaml
+(or: python -m areal_tpu.launcher.local examples/countdown/countdown_grpo.py --config ...)
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_spec = importlib.util.spec_from_file_location(
+    "gsm8k_grpo", os.path.join(_REPO, "examples", "math", "gsm8k_grpo.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+
+
+def main(argv):
+    _mod.main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

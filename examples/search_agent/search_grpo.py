@@ -1,0 +1,35 @@
+"""Search-agent GRPO — retrieval-augmented QA agent RL.
+
+Behavioral counterpart of the reference's search-agent example
+(`examples/search-agent/local_1.5b_example.yaml`, the ASearcher recipe):
+the model interleaves `<search>query</search>` calls with reasoning;
+`SearchQAAgent` executes each query against the episode's corpus
+(`LocalSearchEnv` — BM25-lite over local passages; swap the env for a
+retrieval service in production) and injects the hits as
+`<information>` blocks, then grades the boxed answer.
+
+This entry point delegates to the shared GRPO loop
+(examples/math/gsm8k_grpo.py) with `workflow: search`.
+
+Launch:  python examples/search_agent/search_grpo.py --config examples/search_agent/search_grpo.yaml
+(or: python -m areal_tpu.launcher.local examples/search_agent/search_grpo.py --config ...)
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_spec = importlib.util.spec_from_file_location(
+    "gsm8k_grpo", os.path.join(_REPO, "examples", "math", "gsm8k_grpo.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+
+
+def main(argv):
+    _mod.main(argv)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

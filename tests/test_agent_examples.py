@@ -1,0 +1,257 @@
+"""Countdown + search-agent entry points (VERDICT r2 #6): dataset loaders,
+the SearchQAAgent tool loop, and launcher end-to-end smoke runs through the
+`workflow=countdown|search` branches (reference: examples/countdown/train.py,
+examples/search-agent/local_1.5b_example.yaml)."""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.fixtures import make_tiny_ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_countdown_synthetic_dataset_is_solvable():
+    from areal_tpu.agent.countdown_env import verify_countdown
+    from areal_tpu.dataset import get_custom_dataset
+
+    rows = get_custom_dataset(path="synthetic:16", type="countdown")
+    assert len(rows) == 16
+    for r in rows:
+        assert {"messages", "numbers", "target", "query_id"} <= set(r)
+        # puzzles are built from their own numbers: the generating
+        # left-fold expression must verify
+        assert str(r["target"]) in r["messages"][0]["content"]
+
+
+def test_countdown_manifest_loader(tmp_path):
+    import json
+
+    from areal_tpu.dataset import get_custom_dataset
+
+    p = tmp_path / "train.jsonl"
+    p.write_text(
+        json.dumps({"numbers": [3, 7, 2], "target": 21}) + "\n"
+    )
+    rows = get_custom_dataset(path=str(tmp_path), type="countdown")
+    assert rows[0]["numbers"] == [3, 7, 2] and rows[0]["target"] == 21
+
+
+def test_searchqa_loader_shared_corpus(tmp_path):
+    import json
+
+    from areal_tpu.dataset import get_custom_dataset
+
+    (tmp_path / "corpus.txt").write_text(
+        "Paris is the capital of France.\nEverest is the highest mountain.\n"
+    )
+    (tmp_path / "train.jsonl").write_text(
+        json.dumps({"question": "Capital of France?", "answer": "Paris"}) + "\n"
+    )
+    rows = get_custom_dataset(path=str(tmp_path), type="searchqa")
+    assert rows[0]["answer"] == "Paris"
+    assert len(rows[0]["corpus"]) == 2
+    assert "<search>" in rows[0]["messages"][0]["content"]
+
+
+class _Tok:
+    def encode(self, t, add_special_tokens=False):
+        return [ord(c) % 256 for c in t]
+
+    def decode(self, t):
+        return "".join(chr(x) for x in t)
+
+
+class _ScriptedEngine:
+    """First call emits a <search> query (plus overshoot the agent must
+    discard); after the injected <information> block, emits the answer."""
+
+    def __init__(self):
+        self.calls = []
+
+    async def agenerate(self, req):
+        self.calls.append(list(req.input_ids))
+        text = "".join(chr(x) for x in req.input_ids)
+        if "<information>" in text:
+            out_text = "So the answer is \\boxed{Paris}"
+        else:
+            out_text = "Let me look. <search>capital France</search> hmm..."
+        out = [ord(c) % 256 for c in out_text]
+
+        class R:
+            input_tokens = list(req.input_ids)
+            output_tokens = out
+            output_logprobs = [-0.25] * len(out)
+            output_versions = [3] * len(out)
+            input_len = len(req.input_ids)
+            output_len = len(out)
+            stop_reason = "stop"
+
+        return R()
+
+
+def test_search_agent_tool_loop_injects_information():
+    from areal_tpu.agent import AgentWorkflow, SearchQAAgent
+    from areal_tpu.agent.search_env import LocalSearchEnv
+    from areal_tpu.api.config import GenerationHyperparameters
+
+    corpus = [
+        "Paris is the capital of France.",
+        "Everest is the highest mountain.",
+    ]
+    wf = AgentWorkflow(
+        SearchQAAgent(
+            GenerationHyperparameters(n_samples=1, max_new_tokens=256),
+            tokenizer=_Tok(),
+        ),
+        env_factory=lambda data: LocalSearchEnv(data["corpus"], data["answer"]),
+    )
+    eng = _ScriptedEngine()
+    batch = asyncio.run(
+        wf.arun_episode(
+            eng,
+            {
+                "input_ids": _Tok().encode("Q: capital of France?"),
+                "corpus": corpus,
+                "answer": "Paris",
+            },
+        )
+    )
+    assert (batch["rewards"] == 1.0).all()
+    # second generation call saw the injected information block
+    assert len(eng.calls) == 2
+    second_prompt = "".join(chr(x) for x in eng.calls[1])
+    assert "<information>" in second_prompt and "Paris is the capital" in second_prompt
+    # overshoot past </search> was discarded, injected tokens carry no loss
+    ids = batch["input_ids"][0]
+    text = "".join(chr(x) for x in ids.tolist())
+    assert "hmm" not in text
+    lm = np.asarray(batch["loss_mask"][0], bool)
+    info_span = text.find("<information>"), text.find("</information>")
+    assert not lm[info_span[0]: info_span[1]].any()
+
+
+def _launch(example_rel, cfg_text, tmp_path, fileroot):
+    cfg_path = tmp_path / "cfg.yaml"
+    cfg_path.write_text(cfg_text)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "areal_tpu.launcher.local",
+         os.path.join(REPO, example_rel), "--config", str(cfg_path)],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=540)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"launcher timed out.\n{out[-4000:]}")
+    trainer_log = ""
+    logs = fileroot
+    if logs.exists():
+        for root, _, files in os.walk(logs):
+            for f in files:
+                if f.startswith("trainer"):
+                    trainer_log += open(os.path.join(root, f)).read()
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\n{out[-2000:]}\n{trainer_log[-4000:]}"
+    )
+    assert "Step 1/" in trainer_log and "done." in trainer_log, trainer_log[-4000:]
+
+
+_COMMON = """
+seed: 1
+total_train_epochs: 1
+total_train_steps: 1
+async_training: true
+cluster:
+  fileroot: {fileroot}
+allocation_mode: "jax:d1+jax:d1"
+gconfig:
+  n_samples: 2
+  max_new_tokens: 16
+  temperature: 1.0
+rollout:
+  max_concurrent_rollouts: 8
+  consumer_batch_size: 4
+  max_head_offpolicyness: 2
+  request_timeout: 120
+gen_server:
+  model_path: {ckpt}
+  max_seqs: 4
+  max_context_len: 256
+actor:
+  path: {ckpt}
+  dtype: float32
+  gradient_checkpointing: false
+  group_size: 2
+  ppo_n_minibatches: 1
+  pack_length_quantum: 64
+  max_pack_length: 256
+  adv_norm:
+    mean_level: group
+    std_level: group
+  optimizer:
+    lr: 1.0e-4
+    warmup_steps_proportion: 0.0
+saver:
+  freq_steps: null
+checkpointer:
+  freq_steps: null
+evaluator:
+  freq_steps: null
+recover:
+  mode: disabled
+stats_logger:
+  fileroot: {fileroot}
+"""
+
+
+@pytest.mark.slow
+def test_countdown_example_end_to_end(tmp_path):
+    ckpt = tmp_path / "model"
+    make_tiny_ckpt(str(ckpt))
+    fileroot = tmp_path / "exp"
+    cfg = (
+        "experiment_name: cdsmoke\ntrial_name: t0\nworkflow: countdown\n"
+        f"tokenizer_path: {ckpt}\n"
+        "train_dataset:\n  path: synthetic:8\n  type: countdown\n"
+        "  batch_size: 4\n"
+        + _COMMON.format(fileroot=fileroot, ckpt=ckpt)
+    )
+    _launch("examples/countdown/countdown_grpo.py", cfg, tmp_path, fileroot)
+
+
+@pytest.mark.slow
+def test_search_example_end_to_end(tmp_path):
+    import json
+
+    ckpt = tmp_path / "model"
+    make_tiny_ckpt(str(ckpt))
+    data_dir = tmp_path / "qa"
+    data_dir.mkdir()
+    (data_dir / "corpus.txt").write_text(
+        "Paris is the capital of France.\nEverest is the highest mountain.\n"
+    )
+    with open(data_dir / "train.jsonl", "w") as f:
+        for i in range(8):
+            f.write(json.dumps(
+                {"question": f"Capital of France? (v{i})", "answer": "Paris"}
+            ) + "\n")
+    fileroot = tmp_path / "exp"
+    cfg = (
+        "experiment_name: sasmoke\ntrial_name: t0\nworkflow: search\n"
+        f"tokenizer_path: {ckpt}\n"
+        f"train_dataset:\n  path: {data_dir}\n  type: searchqa\n"
+        "  batch_size: 4\n"
+        + _COMMON.format(fileroot=fileroot, ckpt=ckpt)
+    )
+    _launch("examples/search_agent/search_grpo.py", cfg, tmp_path, fileroot)
