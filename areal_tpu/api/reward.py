@@ -44,23 +44,31 @@ def _noop() -> int:
     return 0
 
 
+# flips once a pool task has completed: before that, per-call timeouts get
+# a bootstrap allowance (spawn workers re-import the reward fn's module —
+# often pulling in jax — which can exceed the steady-state reward timeout
+# and silently zero the first batch's rewards)
+_pool_warm = False
+BOOTSTRAP_TIMEOUT_SECONDS = 120.0
+
+
 def prewarm_reward_pool(timeout: float = 120.0) -> None:
-    """Spin up the spawn workers ahead of the first real reward call: worker
-    bootstrap (re-importing the reward fn's module, often pulling in jax)
-    can exceed the per-call reward timeout and silently zero the first
-    batch's rewards."""
+    """Spin up the spawn workers ahead of the first real reward call."""
+    global _pool_warm
     pool = _get_pool()
     futs = [pool.submit(_noop) for _ in range(_MAX_WORKERS)]
     for f in futs:
         f.result(timeout=timeout)
+    _pool_warm = True
 
 
 def _recreate_pool():
-    global _pool
+    global _pool, _pool_warm
     with _pool_lock:
         if _pool is not None:
             _pool.shutdown(wait=False, cancel_futures=True)
         _pool = _new_pool()
+        _pool_warm = False
         return _pool
 
 
@@ -78,16 +86,25 @@ class AsyncRewardWrapper:
         self.max_retries = max_retries
 
     async def __call__(self, *args, **kwargs) -> float:
+        global _pool_warm
         loop = asyncio.get_running_loop()
         for attempt in range(self.max_retries):
             pool = _get_pool()
+            # cold pool: allow for spawn-worker bootstrap on the first call
+            timeout = (
+                self.timeout
+                if _pool_warm
+                else max(self.timeout, BOOTSTRAP_TIMEOUT_SECONDS)
+            )
             try:
                 fut = pool.submit(self.reward_fn, *args, **kwargs)
-                return float(
+                result = float(
                     await asyncio.wait_for(
-                        asyncio.wrap_future(fut, loop=loop), timeout=self.timeout
+                        asyncio.wrap_future(fut, loop=loop), timeout=timeout
                     )
                 )
+                _pool_warm = True
+                return result
             except asyncio.TimeoutError:
                 # Do NOT retry a timeout: a running pool task cannot be
                 # cancelled, so resubmitting would occupy a second worker and
@@ -95,7 +112,7 @@ class AsyncRewardWrapper:
                 # (reference behavior: reward_api.py returns 0 on timeout).
                 fut.cancel()
                 logger.warning(
-                    f"reward fn timed out after {self.timeout}s; returning 0"
+                    f"reward fn timed out after {timeout}s; returning 0"
                 )
                 return 0.0
             except BrokenExecutor:

@@ -163,7 +163,29 @@ class GenServer:
         """Transfer path: the trainer streams named arrays — whole, or as
         (offset, bytes) pieces for arrays larger than the chunk budget —
         and `commit` swaps them in (counterpart of the reference's NCCL
-        broadcast bucket protocol, fsdp_engine.py:298-330, over HTTP/DCN)."""
+        broadcast bucket protocol, fsdp_engine.py:298-330, over HTTP/DCN).
+
+        Two encodings: `application/octet-stream` carries the raw bytes in
+        the body with metadata in X-Weight-* headers (the fast path — no
+        base64 inflation or json parse per chunk); a JSON body with
+        `data_b64` remains for legacy clients and for `commit`."""
+        if "application/octet-stream" in request.headers.get("Content-Type", ""):
+            h = request.headers
+            import json as _json
+
+            name = h["X-Weight-Name"]
+            data = await request.read()
+            entry = self._chunk_buf.setdefault(
+                name,
+                {
+                    "buf": bytearray(int(h["X-Weight-Nbytes"])),
+                    "dtype": h.get("X-Weight-Dtype", "bfloat16"),
+                    "shape": _json.loads(h.get("X-Weight-Shape", "[]")),
+                },
+            )
+            off = int(h.get("X-Weight-Offset", 0))
+            entry["buf"][off : off + len(data)] = data
+            return web.json_response({"ok": True, "received": name})
         body = await request.json()
         if body.get("commit"):
             if not self._chunk_buf:
@@ -292,6 +314,9 @@ def main():
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: shard the model + KV cache "
                         "over the first tp local devices")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (MoE serving): shard the "
+                        "[E, ., .] expert leaves over ep devices")
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
     p.add_argument("--server-idx", type=int, default=0)
@@ -304,10 +329,12 @@ def main():
             n_slots=args.n_slots,
             max_seq_len=args.max_seq_len,
             tp=args.tp,
+            ep=args.ep,
         )
     else:
         engine = GenEngine(tiny_config(), n_slots=args.n_slots,
-                           max_seq_len=args.max_seq_len, tp=args.tp)
+                           max_seq_len=args.max_seq_len, tp=args.tp,
+                           ep=args.ep)
     serve(
         engine,
         port=args.port or None,

@@ -34,6 +34,20 @@ from areal_tpu.utils import logging, name_resolve, names, network
 logger = logging.getLogger("rpc.server")
 
 
+def _materialize(result):
+    """json-serializable view of method results: async_stats engines return
+    PendingTrainStats Mappings (deferred device fetches) — reading them
+    here forces the fetch, which is correct at the RPC boundary (the
+    result crosses a process edge as JSON)."""
+    from areal_tpu.utils.stats import PendingTrainStats
+
+    if isinstance(result, PendingTrainStats):
+        return dict(result.materialize())
+    if isinstance(result, list):
+        return [_materialize(r) for r in result]
+    return result
+
+
 class EngineRPCServer:
     def __init__(self, worker: Any):
         self.worker = worker
@@ -47,6 +61,14 @@ class EngineRPCServer:
         method = kwargs.pop("__method__")
         return_batch = kwargs.pop("return_batch", False)
         batch = DistributedBatch.from_bytes(blob).to_dict() if blob else None
+        if return_batch and batch is None:
+            # validate up front: falling through to DistributedBatch(None)
+            # after the method ran would raise OUTSIDE the try below and
+            # hand the client a bare 500 without the {"error": ...} contract
+            return web.json_response(
+                {"error": "return_batch=True requires a batch blob"},
+                status=400,
+            )
 
         # re-hydrate meta dataclasses
         if method == "update_weights" and "meta" in kwargs:
@@ -90,7 +112,7 @@ class EngineRPCServer:
                 body=DistributedBatch(result).to_bytes(),
                 content_type="application/octet-stream",
             )
-        return web.json_response({"result": result})
+        return web.json_response({"result": _materialize(result)})
 
     async def health(self, request: web.Request) -> web.Response:
         version = None

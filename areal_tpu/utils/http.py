@@ -28,21 +28,33 @@ async def arequest_with_retry(
     timeout: float = 3600,
     retry_delay: float = 0.5,
     session: Optional[aiohttp.ClientSession] = None,
+    data: Optional[bytes] = None,
+    headers: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Any]:
+    """JSON request (default) or raw-bytes upload (`data` + `headers`)
+    with retry/backoff.  `timeout` applies per request even on a shared
+    session (aiohttp per-request override)."""
     url = f"http://{addr}{endpoint}"
     last_exc: Optional[BaseException] = None
     owns_session = session is None
     if owns_session:
-        session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=min(30, timeout)),
-            connector=get_default_connector(),
-        )
+        session = aiohttp.ClientSession(connector=get_default_connector())
+    req_timeout = aiohttp.ClientTimeout(
+        total=timeout, sock_connect=min(30, timeout)
+    )
     try:
         for attempt in range(max_retries):
             try:
-                async with session.request(
-                    method, url, json=payload if method != "GET" else None
-                ) as resp:
+                kwargs: Dict[str, Any] = {"timeout": req_timeout}
+                if data is not None:
+                    kwargs["data"] = data
+                    kwargs["headers"] = {
+                        "Content-Type": "application/octet-stream",
+                        **(headers or {}),
+                    }
+                elif method != "GET":
+                    kwargs["json"] = payload
+                async with session.request(method, url, **kwargs) as resp:
                     if resp.status == 200:
                         ctype = resp.headers.get("Content-Type", "")
                         if "application/json" in ctype:
@@ -62,6 +74,31 @@ async def arequest_with_retry(
     finally:
         if owns_session:
             await session.close()
+
+
+async def apost_bytes_with_retry(
+    addr: str,
+    endpoint: str,
+    data: bytes,
+    headers: Optional[Dict[str, str]] = None,
+    max_retries: int = 3,
+    timeout: float = 3600,
+    retry_delay: float = 0.5,
+    session: Optional[aiohttp.ClientSession] = None,
+) -> Dict[str, Any]:
+    """POST a raw `application/octet-stream` body (weight-chunk fast path:
+    no base64 inflation, no json parse per chunk)."""
+    return await arequest_with_retry(
+        addr=addr,
+        endpoint=endpoint,
+        method="POST",
+        max_retries=max_retries,
+        timeout=timeout,
+        retry_delay=retry_delay,
+        session=session,
+        data=data,
+        headers=headers,
+    )
 
 
 def request_with_retry_sync(

@@ -235,3 +235,32 @@ def test_train_controller_two_workers():
             h.stop()
         for a in actors:
             a.destroy()
+
+
+def test_return_batch_without_blob_is_a_json_400():
+    """ADVICE r2: return_batch=True with no batch blob must come back as a
+    structured {"error": ...} 400, not a bare AttributeError 500."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from areal_tpu.scheduler.wire import encode_frame
+
+    actor = _actor()
+    h = ServerHarness(actor)
+    addr = h.start()
+    try:
+        body = encode_frame(
+            {"__method__": "get_version", "return_batch": True}, b""
+        )
+        req = urllib.request.Request(
+            f"http://{addr}/call", data=body, method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+        payload = json.loads(ei.value.read())
+        assert "batch blob" in payload["error"]
+    finally:
+        h.stop()
+        actor.destroy()
