@@ -307,9 +307,12 @@ class StatsLoggerConfig:
 
 @dataclass
 class NameResolveConfig:
-    type: str = "memory"  # memory | nfs | etcd3
+    # http = first-party TTL'd KV service (utils/kv_store.py), the
+    # distributed-fleet backend (etcd3-lease semantics without etcd)
+    type: str = "memory"  # memory | nfs | http
     nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
-    etcd3_addr: str = "localhost:2379"
+    http_addr: str = "localhost:18999"
+    etcd3_addr: str = "localhost:2379"  # legacy field; etcd3 -> use http
 
 
 @dataclass

@@ -37,7 +37,29 @@ def _get_pool() -> ProcessPoolExecutor:
     with _pool_lock:
         if _pool is None:
             _pool = _new_pool()
+            _warm_async(_pool)
         return _pool
+
+
+def _warm_async(pool: ProcessPoolExecutor) -> None:
+    """Kick one noop per worker and flip _pool_warm only when ALL complete:
+    warmth is per-worker — a single fast reward on worker 1 proves nothing
+    about worker 3 still importing jax."""
+    remaining = [_MAX_WORKERS]
+    lock = threading.Lock()
+
+    def _done(_):
+        global _pool_warm
+        with lock:
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                _pool_warm = True
+
+    try:
+        for _ in range(_MAX_WORKERS):
+            pool.submit(_noop).add_done_callback(_done)
+    except Exception:  # noqa: BLE001 — pool may be shutting down
+        pass
 
 
 def _noop() -> int:
@@ -86,7 +108,6 @@ class AsyncRewardWrapper:
         self.max_retries = max_retries
 
     async def __call__(self, *args, **kwargs) -> float:
-        global _pool_warm
         loop = asyncio.get_running_loop()
         for attempt in range(self.max_retries):
             pool = _get_pool()
@@ -98,13 +119,11 @@ class AsyncRewardWrapper:
             )
             try:
                 fut = pool.submit(self.reward_fn, *args, **kwargs)
-                result = float(
+                return float(
                     await asyncio.wait_for(
                         asyncio.wrap_future(fut, loop=loop), timeout=timeout
                     )
                 )
-                _pool_warm = True
-                return result
             except asyncio.TimeoutError:
                 # Do NOT retry a timeout: a running pool task cannot be
                 # cancelled, so resubmitting would occupy a second worker and

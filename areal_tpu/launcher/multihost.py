@@ -42,6 +42,23 @@ RECOVER_TIME_INTERVAL = 10.0
 COORDINATOR_PORT_BASE = 20000
 
 
+_LOOPBACK = ("localhost", "127.0.0.1", "::1", "0.0.0.0")
+
+
+def _check_kv_addr_reachable_from_remote(addr: str, hosts: List[str]) -> None:
+    """A loopback kv_store address handed to REMOTE hosts points each worker
+    at itself — catch the misconfiguration at launch, not as a fleet-wide
+    rendezvous hang."""
+    host = addr.rsplit(":", 1)[0]
+    remote = [h for h in hosts if h not in _LOOPBACK]
+    if host in _LOOPBACK and remote:
+        raise ValueError(
+            f"name_resolve.http_addr={addr!r} is a loopback address but the "
+            f"fleet spans remote hosts {remote}; use an address every host "
+            f"can reach (e.g. the launcher host's IP)"
+        )
+
+
 def ssh_shell(host: str, cmd: str, env: Dict[str, str], workdir: str) -> List[str]:
     """Wrap a command for remote execution over ssh.
 
@@ -84,13 +101,19 @@ class MultiHostLauncher:
         self.coordinator_host = coordinator_host or train_hosts[0]
         self.procs: List[subprocess.Popen] = []
         nr = self.config.cluster.name_resolve
-        if nr.type != "nfs":
+        if nr.type == "nfs":
+            self._nr_env = f"nfs:{nr.nfs_record_root}"
+        elif nr.type == "http":
+            # TTL'd KV service (utils/kv_store.py): fleets without a shared
+            # filesystem rendezvous through it (etcd-lease semantics)
+            _check_kv_addr_reachable_from_remote(nr.http_addr, train_hosts)
+            self._nr_env = f"http:{nr.http_addr}"
+        else:
             raise ValueError(
-                "multi-host runs need a shared name_resolve store: set "
-                "cluster.name_resolve.type=nfs and nfs_record_root to a "
-                "path visible from every host"
+                "multi-host runs need a shared name_resolve store: "
+                "cluster.name_resolve.type=nfs (shared path) or http "
+                "(kv_store service) reachable from every host"
             )
-        self._nr_env = f"nfs:{nr.nfs_record_root}"
 
     # ------------------------------------------------------------------
 

@@ -124,14 +124,28 @@ class SlurmLauncher:
         self.workdir = workdir or os.getcwd()
         self.job_ids: List[str] = []
         nr = self.config.cluster.name_resolve
-        if nr.type != "nfs":
+        if nr.type == "nfs":
+            self._common_env = {
+                "AREAL_NAME_RESOLVE": f"nfs:{nr.nfs_record_root}",
+            }
+        elif nr.type == "http":
+            # TTL'd KV service (utils/kv_store.py) reachable from every
+            # node — the etcd-style fleet rendezvous.  slurm nodes are
+            # always remote, so a loopback address can never be right.
+            host = nr.http_addr.rsplit(":", 1)[0]
+            if host in ("localhost", "127.0.0.1", "::1", "0.0.0.0"):
+                raise ValueError(
+                    f"name_resolve.http_addr={nr.http_addr!r} is loopback; "
+                    f"slurm nodes need an address they can reach"
+                )
+            self._common_env = {
+                "AREAL_NAME_RESOLVE": f"http:{nr.http_addr}",
+            }
+        else:
             raise ValueError(
-                "slurm runs need cluster.name_resolve.type=nfs on a path "
-                "visible from every node"
+                "slurm runs need cluster.name_resolve.type=nfs (shared "
+                "path) or http (kv_store service) visible from every node"
             )
-        self._common_env = {
-            "AREAL_NAME_RESOLVE": f"nfs:{nr.nfs_record_root}",
-        }
         self._script_dir = os.path.join(
             self.config.cluster.fileroot,
             self.config.experiment_name,

@@ -1,0 +1,339 @@
+"""TTL'd distributed KV service + name_resolve backend.
+
+Capability counterpart of the reference's etcd3 name_resolve backend
+(areal/utils/name_resolve.py:411: leased keys that expire when their owner
+dies, shared by every process in a multi-host fleet).  The etcd3 client
+library is not part of this image, so the same semantics are provided
+first-party: a single aiohttp KV server (start it anywhere reachable —
+typically the launcher host) and an HTTP repository whose TTL'd keys are
+kept alive by a background lease-refresh thread; keys whose owner stops
+refreshing disappear after their TTL, which is exactly the liveness signal
+`watch_names` peer-death detection consumes.
+
+Server:  python -m areal_tpu.utils.kv_store --port 18999
+Client:  AREAL_NAME_RESOLVE=http:<host>:18999   (launchers pass this down)
+"""
+
+import argparse
+import asyncio
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from areal_tpu.utils import logging
+from areal_tpu.utils.name_resolve import (
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NameRecordRepository,
+)
+
+logger = logging.getLogger("kv_store")
+
+DEFAULT_TTL = 30.0  # seconds a leased key survives without a refresh
+
+
+class KVServer:
+    """In-memory hierarchical KV with per-key TTL leases."""
+
+    def __init__(self, sweep_interval: float = 1.0):
+        # name -> (value, expiry_monotonic | None)
+        self._store: Dict[str, Tuple[str, Optional[float]]] = {}
+        self._lock = asyncio.Lock()
+        self.sweep_interval = sweep_interval
+        self._sweeper: Optional[asyncio.Task] = None
+
+    # ------------------------------ core -------------------------------
+
+    def _expired(self, name: str) -> bool:
+        _, exp = self._store[name]
+        return exp is not None and exp < time.monotonic()
+
+    def _prune(self) -> None:
+        now = time.monotonic()
+        dead = [
+            k for k, (_, exp) in self._store.items()
+            if exp is not None and exp < now
+        ]
+        for k in dead:
+            del self._store[k]
+        if dead:
+            logger.info(f"expired {len(dead)} leased keys")
+
+    # ----------------------------- handlers ----------------------------
+
+    async def add(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        name = body["name"].rstrip("/")
+        ttl = body.get("ttl")
+        async with self._lock:
+            self._prune()
+            if name in self._store and not body.get("replace", False):
+                return web.json_response({"error": "exists"}, status=409)
+            self._store[name] = (
+                str(body["value"]),
+                time.monotonic() + ttl if ttl else None,
+            )
+        return web.json_response({"ok": True})
+
+    async def get(self, request):
+        from aiohttp import web
+
+        name = request.query["name"].rstrip("/")
+        async with self._lock:
+            if name not in self._store or self._expired(name):
+                return web.json_response({"error": "not found"}, status=404)
+            return web.json_response({"value": self._store[name][0]})
+
+    async def keys(self, request):
+        from aiohttp import web
+
+        root = request.query["root"].rstrip("/")
+        prefix = root + "/"
+        async with self._lock:
+            self._prune()
+            found = sorted(
+                k for k in self._store if k.startswith(prefix) or k == root
+            )
+            values = [self._store[k][0] for k in found]
+        return web.json_response({"keys": found, "values": values})
+
+    async def delete(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        name = body["name"].rstrip("/")
+        async with self._lock:
+            removed = self._store.pop(name, None)
+        if removed is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"ok": True})
+
+    async def clear(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        prefix = body["root"].rstrip("/") + "/"
+        async with self._lock:
+            dead = [
+                k for k in self._store
+                if k.startswith(prefix) or k == body["root"].rstrip("/")
+            ]
+            for k in dead:
+                del self._store[k]
+        return web.json_response({"ok": True, "removed": len(dead)})
+
+    async def touch(self, request):
+        """Lease refresh: extend the TTL of every named key the caller
+        still owns (the etcd3 keepalive equivalent)."""
+        from aiohttp import web
+
+        body = await request.json()
+        ttl = float(body.get("ttl", DEFAULT_TTL))
+        refreshed = 0
+        async with self._lock:
+            for name in body.get("names", ()):
+                name = name.rstrip("/")
+                if name in self._store and not self._expired(name):
+                    value, _ = self._store[name]
+                    self._store[name] = (value, time.monotonic() + ttl)
+                    refreshed += 1
+        return web.json_response({"ok": True, "refreshed": refreshed})
+
+    async def health(self, request):
+        from aiohttp import web
+
+        async with self._lock:
+            return web.json_response(
+                {"status": "ok", "keys": len(self._store)}
+            )
+
+    # ------------------------------ wiring ------------------------------
+
+    async def _sweep(self):
+        while True:
+            await asyncio.sleep(self.sweep_interval)
+            async with self._lock:
+                self._prune()
+
+    def app(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/kv/add", self.add)
+        app.router.add_get("/kv/get", self.get)
+        app.router.add_get("/kv/keys", self.keys)
+        app.router.add_post("/kv/delete", self.delete)
+        app.router.add_post("/kv/clear", self.clear)
+        app.router.add_post("/kv/touch", self.touch)
+        app.router.add_get("/health", self.health)
+
+        async def _start_sweeper(app):
+            self._sweeper = asyncio.create_task(self._sweep())
+
+        async def _stop_sweeper(app):
+            if self._sweeper is not None:
+                self._sweeper.cancel()
+
+        app.on_startup.append(_start_sweeper)
+        app.on_cleanup.append(_stop_sweeper)
+        return app
+
+
+class HttpNameRecordRepository(NameRecordRepository):
+    """name_resolve backend over a KVServer; TTL'd keys auto-refresh from a
+    keepalive thread, so keys of crashed processes expire — the reference's
+    etcd3 lease behavior (name_resolve.py:411)."""
+
+    def __init__(self, addr: str, ttl: float = DEFAULT_TTL):
+        import requests
+
+        self.addr = addr
+        self.ttl = ttl
+        self._session = requests.Session()
+        self._to_delete: List[str] = []
+        self._leased: List[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._keepalive: Optional[threading.Thread] = None
+
+    # ------------------------- http plumbing ---------------------------
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.addr}{path}"
+
+    def _request(self, method: str, path: str, *, retries: int = 3, **kw):
+        """Transient-error retry: watch_names/wait poll loops only guard
+        against NameEntryNotFoundError, so a single KV-server blip must not
+        escape as a connection error and silently kill a watcher thread."""
+        import requests
+
+        last: Optional[BaseException] = None
+        for attempt in range(retries):
+            try:
+                return self._session.request(
+                    method, self._url(path), timeout=30, **kw
+                )
+            except requests.RequestException as e:
+                last = e
+                if attempt < retries - 1:
+                    time.sleep(0.5 * (2**attempt))
+        logger.warning(f"kv store unreachable after {retries} tries: {last}")
+        raise last
+
+    def _post(self, path: str, payload: dict, ok_statuses=(200,)):
+        r = self._request("POST", path, json=payload)
+        if r.status_code not in ok_statuses:
+            r.raise_for_status()
+        return r
+
+    # ------------------------------ ABC --------------------------------
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        ttl = keepalive_ttl if keepalive_ttl else (
+            self.ttl if delete_on_exit else None
+        )
+        r = self._post(
+            "/kv/add",
+            {"name": name, "value": str(value), "replace": replace,
+             "ttl": ttl},
+            ok_statuses=(200, 409),
+        )
+        if r.status_code == 409:
+            raise NameEntryExistsError(name)
+        with self._lock:
+            if delete_on_exit:
+                self._to_delete.append(name)
+            if ttl:
+                self._leased.append(name)
+                self._ensure_keepalive()
+
+    def get(self, name):
+        r = self._request(
+            "GET", "/kv/get", params={"name": name.rstrip("/")}
+        )
+        if r.status_code == 404:
+            raise NameEntryNotFoundError(name)
+        r.raise_for_status()
+        return r.json()["value"]
+
+    def _keys(self, name_root):
+        r = self._request(
+            "GET", "/kv/keys", params={"root": name_root.rstrip("/")}
+        )
+        r.raise_for_status()
+        return r.json()
+
+    def get_subtree(self, name_root):
+        return self._keys(name_root)["values"]
+
+    def find_subtree(self, name_root):
+        return self._keys(name_root)["keys"]
+
+    def delete(self, name):
+        r = self._post(
+            "/kv/delete", {"name": name.rstrip("/")}, ok_statuses=(200, 404)
+        )
+        if r.status_code == 404:
+            raise NameEntryNotFoundError(name)
+        with self._lock:
+            if name in self._leased:
+                self._leased.remove(name)
+
+    def clear_subtree(self, name_root):
+        self._post("/kv/clear", {"root": name_root})
+
+    def reset(self):
+        self._stop.set()
+        with self._lock:
+            names, self._to_delete = self._to_delete, []
+            self._leased = []
+        for name in names:
+            try:
+                self.delete(name)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    # --------------------------- keepalive -----------------------------
+
+    def _ensure_keepalive(self):
+        if self._keepalive is None or not self._keepalive.is_alive():
+            self._stop.clear()
+            self._keepalive = threading.Thread(
+                target=self._keepalive_loop, daemon=True
+            )
+            self._keepalive.start()
+
+    def _keepalive_loop(self):
+        interval = max(0.2, self.ttl / 3)
+        while not self._stop.wait(interval):
+            with self._lock:
+                names = list(self._leased)
+            if not names:
+                continue
+            try:
+                self._post("/kv/touch", {"names": names, "ttl": self.ttl})
+            except Exception as e:  # noqa: BLE001 — server blip: keys may
+                # expire, peers will see this process as dead (intended)
+                logger.warning(f"kv keepalive failed: {e}")
+
+
+
+def main():
+    from aiohttp import web
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=18999)
+    p.add_argument("--sweep-interval", type=float, default=1.0)
+    args = p.parse_args()
+    server = KVServer(sweep_interval=args.sweep_interval)
+    logger.info(f"kv store on {args.host}:{args.port}")
+    web.run_app(server.app(), host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -289,10 +289,15 @@ def reconfigure(config: NameResolveConfig):
         DEFAULT_REPOSITORY = MemoryNameRecordRepository()
     elif config.type == "nfs":
         DEFAULT_REPOSITORY = NfsNameRecordRepository(config.nfs_record_root)
+    elif config.type == "http":
+        from areal_tpu.utils.kv_store import HttpNameRecordRepository
+
+        DEFAULT_REPOSITORY = HttpNameRecordRepository(config.http_addr)
     elif config.type == "etcd3":
         raise NotImplementedError(
             "etcd3 client is not available in this environment; "
-            "use type='nfs' on a shared filesystem instead"
+            "type='http' (areal_tpu.utils.kv_store — same TTL-lease "
+            "semantics, first-party server) replaces it"
         )
     else:
         raise ValueError(f"unknown name_resolve backend {config.type!r}")
@@ -306,6 +311,10 @@ def reconfigure_from_env(fallback: "NameResolveConfig" = None):
     spec = os.environ.get("AREAL_NAME_RESOLVE", "")
     if spec.startswith("nfs:"):
         reconfigure(NameResolveConfig(type="nfs", nfs_record_root=spec[4:]))
+    elif spec.startswith("http:"):
+        reconfigure(
+            NameResolveConfig(type="http", http_addr=spec[len("http:"):])
+        )
     elif spec == "memory":
         reconfigure(NameResolveConfig(type="memory"))
     elif fallback is not None and fallback.type != "memory":

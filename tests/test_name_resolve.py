@@ -12,10 +12,44 @@ from areal_tpu.utils.name_resolve import (
 )
 
 
-@pytest.fixture(params=["memory", "nfs"])
+def _start_kv_server():
+    import asyncio
+
+    from aiohttp import web
+
+    from areal_tpu.utils.kv_store import KVServer
+
+    server = KVServer(sweep_interval=0.1)
+    holder, started = {}, threading.Event()
+
+    def _run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def go():
+            runner = web.AppRunner(server.app())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["addr"] = f"127.0.0.1:{runner.addresses[0][1]}"
+            started.set()
+
+        loop.run_until_complete(go())
+        loop.run_forever()
+
+    threading.Thread(target=_run, daemon=True).start()
+    assert started.wait(10)
+    return holder["addr"]
+
+
+@pytest.fixture(params=["memory", "nfs", "http"])
 def repo(request, tmp_path):
     if request.param == "memory":
         return MemoryNameRecordRepository()
+    if request.param == "http":
+        from areal_tpu.utils.kv_store import HttpNameRecordRepository
+
+        return HttpNameRecordRepository(_start_kv_server(), ttl=2.0)
     return NfsNameRecordRepository(str(tmp_path / "nr"))
 
 
@@ -91,3 +125,41 @@ def test_watch_names_fires_when_peer_never_appears():
     fired = threading.Event()
     repo.watch_names(["never/appears"], fired.set, poll_frequency=0.02, wait_timeout=0.1)
     assert fired.wait(timeout=2)
+
+
+def test_http_ttl_lease_expires_without_keepalive():
+    """kv_store: a TTL'd key whose owner stops refreshing disappears — the
+    etcd3-lease liveness signal (reference name_resolve.py:411)."""
+    from areal_tpu.utils.kv_store import HttpNameRecordRepository
+
+    addr = _start_kv_server()
+    # generous ttl: the keepalive thread refreshes at ttl/3, and a loaded
+    # CI runner must not be able to miss a whole window
+    owner = HttpNameRecordRepository(addr, ttl=3.0)
+    reader = HttpNameRecordRepository(addr, ttl=3.0)
+    owner.add("fleet/worker/0", "alive", keepalive_ttl=3.0)
+    assert reader.get("fleet/worker/0") == "alive"
+    time.sleep(4.0)  # > ttl: only the keepalive can have kept it alive
+    assert reader.get("fleet/worker/0") == "alive"
+    owner._stop.set()  # owner "crashes": no more refreshes
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            reader.get("fleet/worker/0")
+            time.sleep(0.1)
+        except NameEntryNotFoundError:
+            break
+    else:
+        raise AssertionError("leased key never expired")
+
+
+def test_http_backend_via_env(monkeypatch):
+    addr = _start_kv_server()
+    monkeypatch.setenv("AREAL_NAME_RESOLVE", f"http:{addr}")
+    name_resolve.reconfigure_from_env()
+    try:
+        name_resolve.add("env/test/x", "42", delete_on_exit=False)
+        assert name_resolve.get("env/test/x") == "42"
+        assert name_resolve.find_subtree("env/test") == ["env/test/x"]
+    finally:
+        name_resolve.DEFAULT_REPOSITORY = MemoryNameRecordRepository()
