@@ -46,43 +46,136 @@ def _pow2_at_least(x: float, cap: int) -> int:
     return p
 
 
-def auto_allocation(
+def _pow2_divisors(n: int):
+    p = 1
+    while p <= n:
+        if n % p == 0:
+            yield p
+        p *= 2
+
+
+def search_allocation(
     n_devices: int,
     n_params: float,
-    gen_fraction: float = 0.75,
+    ctx_len: int = 4096,
+    gen_cost_ratio: float = 3.0,
     hbm_bytes: Optional[int] = None,
     device_kind: str = "default",
-) -> str:
-    """Pick a disaggregated allocation expression for an async-RL run.
+    hidden_size: Optional[float] = None,
+    num_layers: Optional[float] = None,
+    gen_concurrency: int = 32,
+) -> Dict:
+    """Enumerate-and-score allocation search (the depth of the reference's
+    device-mesh search, realhf/api/quickstart/device_mesh.py:274, with a
+    TPU cost model instead of GPU profiles).
 
-    Returns e.g. "jax:d6t2+jax:d1f2t2" — gen servers on the left of '+',
-    trainer mesh on the right (api/alloc.py dialect)."""
+    Every pow-2 split of chips into gen (dp x tp) and train
+    (fsdp x sp x tp) is checked for HBM feasibility and scored by a
+    throughput model:
+
+    - trainer consumption ~ n_train scaled by a collective-overhead factor
+      per doubling of tp/sp (intra-replica collectives ride ICI but still
+      cost bandwidth);
+    - generation supply ~ n_gen similarly scaled; the system rate is
+      min(train_rate, gen_rate / gen_cost_ratio) — async RL is
+      generation-bound, the reference benchmarks split chips ~3:1;
+    - memory: train state bytes shard over (tp x fsdp), activation bytes
+      (~ctx-linear under remat) over (tp x sp); serving weights AND the
+      KV cache for `gen_concurrency` sequences of ctx_len shard over the
+      serving tp.
+
+    Returns {"expr", "score", "n_gen", "n_train", ...} for the best split.
+    """
     if n_devices < 2:
         raise ValueError("async RL needs >= 2 chips (gen + train)")
     hbm = hbm_bytes or HBM_BYTES.get(device_kind, HBM_BYTES["default"])
+    # coarse dense-transformer shape: real models keep layers ~ hidden/128
+    # (e.g. Qwen2.5-7B: 3584/28), so from n = 12*L*h^2 = 12*h^3/128:
+    if hidden_size:
+        hidden = hidden_size
+        layers = num_layers or max(4.0, n_params / (12 * hidden * hidden))
+    else:
+        hidden = max(512.0, 128.0 * round((n_params * 128 / 12) ** (1 / 3) / 128))
+        layers = num_layers or max(4.0, n_params / (12 * hidden * hidden))
+    # per-token activation bytes under full remat: layer inputs + head
+    act_bytes_per_token = 2.0 * hidden * (layers + 8)
+    # per-token KV bytes (bf16 K+V, GQA kv width ~hidden/4)
+    kv_bytes_per_token = 2.0 * 2.0 * layers * (hidden / 4)
+    train_state = n_params * TRAIN_BYTES_PER_PARAM
+    gen_state = n_params * GEN_BYTES_PER_PARAM
 
-    train_tp = _pow2_at_least(n_params * TRAIN_BYTES_PER_PARAM / hbm, n_devices)
-    gen_tp = _pow2_at_least(n_params * GEN_BYTES_PER_PARAM / hbm, n_devices)
+    def axis_eff(k: int, per_double: float) -> float:
+        return 1.0 / (1.0 + per_double * math.log2(max(k, 1)))
 
-    n_gen = max(gen_tp, int(n_devices * gen_fraction) // gen_tp * gen_tp)
-    n_train = n_devices - n_gen
-    if n_train < train_tp:
-        # shrink the gen share until one training replica fits
-        while n_train < train_tp and n_gen - gen_tp >= gen_tp:
-            n_gen -= gen_tp
+    # KV cache for the concurrent-rollout budget shards over the serving tp
+    # axis along with the weights
+    gen_kv = gen_concurrency * ctx_len * kv_bytes_per_token
+    best = None
+    for gen_tp in _pow2_divisors(n_devices):
+        if (gen_state + gen_kv) / gen_tp > hbm:
+            continue
+        for n_gen in range(gen_tp, n_devices, gen_tp):
             n_train = n_devices - n_gen
-        if n_train < train_tp:
-            raise ValueError(
-                f"{n_devices} chips cannot host train tp={train_tp} "
-                f"plus a gen server (model {n_params / 1e9:.1f}B)"
-            )
-    gen_dp = n_gen // gen_tp
-    fsdp = n_train // train_tp
-    gen = f"jax:d{gen_dp}" + (f"t{gen_tp}" if gen_tp > 1 else "")
-    train = f"jax:f{fsdp}" + (f"t{train_tp}" if train_tp > 1 else "")
-    expr = f"{gen}+{train}"
-    AllocationMode.from_str(expr)  # validate against the real parser
-    return expr
+            gen_rate = n_gen * axis_eff(gen_tp, 0.10)
+            for tp in _pow2_divisors(n_train):
+                for sp in _pow2_divisors(n_train // tp):
+                    fsdp = n_train // (tp * sp)
+                    state_pc = train_state / (tp * fsdp)
+                    act_pc = ctx_len * act_bytes_per_token / (tp * sp)
+                    if state_pc + act_pc > hbm:
+                        continue
+                    train_rate = n_train * axis_eff(tp, 0.08) * axis_eff(sp, 0.05)
+                    score = min(train_rate, gen_rate / gen_cost_ratio)
+                    # prefer simpler meshes on ties (fewer sharded axes)
+                    complexity = (tp > 1) + (sp > 1) + (gen_tp > 1)
+                    key = (score, -complexity, n_gen)
+                    if best is None or key > best["key"]:
+                        gen = f"jax:d{n_gen // gen_tp}" + (
+                            f"t{gen_tp}" if gen_tp > 1 else ""
+                        )
+                        train = "jax:" + (f"f{fsdp}" if fsdp > 1 else "d1") + (
+                            f"s{sp}" if sp > 1 else ""
+                        ) + (f"t{tp}" if tp > 1 else "")
+                        best = {
+                            "key": key,
+                            "expr": f"{gen}+{train}",
+                            "score": score,
+                            "n_gen": n_gen,
+                            "n_train": n_train,
+                            "gen_tp": gen_tp,
+                            "train_tp": tp,
+                            "train_sp": sp,
+                            "train_fsdp": fsdp,
+                        }
+    if best is None:
+        raise ValueError(
+            f"{n_devices} chips cannot host model {n_params / 1e9:.1f}B at "
+            f"ctx {ctx_len} (train state {train_state / 1e9:.1f} GB)"
+        )
+    AllocationMode.from_str(best["expr"])  # validate against the real parser
+    del best["key"]
+    return best
+
+
+def auto_allocation(
+    n_devices: int,
+    n_params: float,
+    gen_fraction: float = 0.75,  # kept for API compat; the search owns the split
+    hbm_bytes: Optional[int] = None,
+    device_kind: str = "default",
+    ctx_len: int = 4096,
+) -> str:
+    """Pick a disaggregated allocation expression for an async-RL run.
+
+    Returns e.g. "jax:d6t2+jax:f2t2" — gen servers on the left of '+',
+    trainer mesh on the right (api/alloc.py dialect)."""
+    return search_allocation(
+        n_devices,
+        n_params,
+        ctx_len=ctx_len,
+        hbm_bytes=hbm_bytes,
+        device_kind=device_kind,
+    )["expr"]
 
 
 # ---------------------------------------------------------------------------

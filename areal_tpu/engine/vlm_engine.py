@@ -29,6 +29,7 @@ import numpy as np
 
 from areal_tpu.api.config import TrainEngineConfig
 from areal_tpu.engine.jax_train import JaxTrainEngine
+from areal_tpu.engine.sft.lm_engine import JaxLMEngine
 from areal_tpu.models.model_config import TransformerConfig
 from areal_tpu.models.vision import forward_vlm_lm, init_vision_params
 from areal_tpu.utils.data import RowPackedBatch, VISION_PATCH_KEYS
@@ -242,6 +243,14 @@ class JaxVLMEngine(JaxTrainEngine):
             patch_pos_hw=batch.get("patch_pos_hw"),
             mesh=self.mesh,
         )
+
+
+class JaxVLMLMEngine(JaxVLMEngine, JaxLMEngine):
+    """Supervised finetuning on the VLM engine — the counterpart of the
+    reference's VLM SFT path (examples/vlm/clevr_count_70k_sft.py over the
+    BaseHFEngine VLM branch).  train_lm/evaluate_lm come from the text LM
+    engine unchanged; only the model call and batch preparation differ
+    (JaxVLMEngine overrides win in the MRO)."""
 
 
 class VLMPPOActor:

@@ -101,6 +101,35 @@ def vision_rot_pos_ids(
     return np.concatenate(out).astype(np.int32)
 
 
+def patch_arrays_for_rows(grids, spatial_merge_size: int = 2):
+    """Per-row image grids -> the batch patch bookkeeping every consumer
+    shares (workflow augmentation, SFT collate): globally-renumbered
+    per-patch image ids [N], 2D rotary coords [N, 2], and per-row patch
+    spans [R] (the metadata row-wise splitters carve patch arrays with)."""
+    ids_parts, pos_parts, spans = [], [], []
+    base = 0
+    for grid in grids:
+        grid = np.asarray(grid, np.int64).reshape(-1, 3)
+        per_image = (grid[:, 0] * grid[:, 1] * grid[:, 2]).astype(np.int64)
+        ids_parts.append(
+            np.repeat(np.arange(len(grid)) + base, per_image).astype(np.int32)
+        )
+        pos_parts.append(vision_rot_pos_ids(grid, spatial_merge_size))
+        base += len(grid)
+        spans.append(int(per_image.sum()))
+    if not ids_parts:
+        return (
+            np.zeros(0, np.int32),
+            np.zeros((0, 2), np.int32),
+            np.zeros(0, np.int64),
+        )
+    return (
+        np.concatenate(ids_parts),
+        np.concatenate(pos_parts),
+        np.asarray(spans, np.int64),
+    )
+
+
 def _vision_rope_angles(cfg: VisionConfig, patch_pos_hw: jax.Array) -> jax.Array:
     """[N, 2] (h, w) coords -> rotary angles [N, head_dim/2]: the first
     half of the frequency bands rotate by the h coordinate, the second by

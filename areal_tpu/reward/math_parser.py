@@ -53,6 +53,9 @@ def _find_boxed(text: str) -> Optional[str]:
 _ANSWER_PATTERNS = [
     r"(?:final answer|the answer)\s*(?:is\s*:?|:)\s*([^\n]+)",
     r"####\s*([^\n]+)",
+    # bare "Answer: 042" lines (AIME-style submissions)
+    r"^answer\s*:\s*([^\n]+)",
+    r"\nanswer\s*:\s*([^\n]+)",
 ]
 
 
@@ -157,6 +160,13 @@ def _fix_fracs(s: str) -> str:
     return s
 
 
+def _fix_binom(s: str) -> str:
+    """\\binom{n}{k} / \\dbinom -> binomial(n, k) (sympy-parseable)."""
+    return re.sub(
+        r"\\d?binom\s*\{([^{}]*)\}\s*\{([^{}]*)\}", r"binomial(\1,\2)", s
+    )
+
+
 def _fix_sqrt(s: str) -> str:
     s = re.sub(r"\\sqrt\s*\{([^{}]*)\}", r"sqrt(\1)", s)
     s = re.sub(r"\\sqrt\s*(\w)", r"sqrt(\1)", s)
@@ -182,6 +192,7 @@ def normalize_answer(ans: str) -> str:
         s = re.sub(pat, rep, s)
     for w, d in _WORD_NUMBERS.items():
         s = re.sub(rf"\b{w}\b", d, s, flags=re.IGNORECASE)
+    s = _fix_binom(s)  # before fracs: brace structure must survive
     s = _fix_sqrt(s)  # before fracs: \frac{\sqrt{3}}{3} loses inner braces
     s = _fix_fracs(s)
     # "x = 5" / "k=5" style prefixes: keep the value side.  lhs must be a
@@ -266,6 +277,33 @@ def _pmatrix_rows(s: str) -> Optional[List[List[str]]]:
     return [row.split("&") for row in m.group(1).split("\\\\") if row]
 
 
+def _numeric_eval(s: str) -> Optional[float]:
+    """Float value of a closed-form expression (sqrt/pi/binomial/fractions),
+    None when it stays symbolic (free variables) or fails to parse."""
+    import sympy
+    from sympy.parsing.sympy_parser import (
+        implicit_multiplication_application,
+        parse_expr,
+        standard_transformations,
+    )
+
+    try:
+        e = parse_expr(
+            s,
+            transformations=standard_transformations
+            + (implicit_multiplication_application,),
+            evaluate=True,
+        )
+        if e.free_symbols:
+            return None
+        v = sympy.N(e)
+        if v.is_real is False:
+            return None
+        return float(v)
+    except Exception:  # noqa: BLE001 — not numerically evaluable
+        return None
+
+
 def _sympy_equal(p: str, t: str) -> bool:
     import sympy
     from sympy.parsing.sympy_parser import (
@@ -316,6 +354,21 @@ def math_equal(
         return any(
             abs(pn - c) <= rel_tol * max(1.0, abs(c)) for c in candidates
         )
+    if (pn is None) != (tn is None):
+        # decimal vs closed form ("1.618..." vs (1+sqrt(5))/2): evaluate the
+        # symbolic side numerically and compare under the same tolerance —
+        # with the same percentage candidates as the numeric-numeric branch,
+        # so equivalent (pred, target) pairs score identically either way
+        sym, num = (t, pn) if pn is not None else (p, tn)
+        val = _numeric_eval(sym)
+        if val is not None:
+            candidates = [val]
+            if include_percentage:
+                candidates = [val / 100.0, val, val * 100.0]
+            return any(
+                abs(num - c) <= rel_tol * max(1.0, abs(c))
+                for c in candidates
+            )
 
     if depth < 3:
         # tuples / intervals / coordinate pairs: element-wise

@@ -151,24 +151,16 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             return batch  # image_data-only mode: text-style training rows
         import numpy as np
 
+        from areal_tpu.models.vision import patch_arrays_for_rows
+
         pv = np.asarray(data["pixel_values"], np.float32)
         grid = np.asarray(data["image_grid_thw"], np.int64).reshape(-1, 3)
-        n_img = grid.shape[0]
-        per_image = (grid[:, 0] * grid[:, 1] * grid[:, 2]).astype(np.int64)
-        ids_one = np.repeat(np.arange(n_img), per_image)
         batch["pixel_values"] = np.tile(pv, (n_samples, 1))
-        batch["patch_img_ids"] = np.concatenate(
-            [ids_one + r * n_img for r in range(n_samples)]
-        ).astype(np.int32)
-        # per-patch (h, w) rotary coords for the tower's 2D rope
-        from areal_tpu.models.vision import vision_rot_pos_ids
-
-        pos_one = vision_rot_pos_ids(grid, self.spatial_merge_size)
-        batch["patch_pos_hw"] = np.tile(pos_one, (n_samples, 1))
-        # per-row patch counts: the metadata that lets row-wise splitters
-        # (controller dp fan-out, micro-batching) carve the patch arrays
-        # consistently with the rows
-        batch["patches_per_row"] = np.full(
-            n_samples, int(per_image.sum()), np.int64
+        # every sample row repeats the episode's image(s): one grid per row
+        ids, pos_hw, spans = patch_arrays_for_rows(
+            [grid] * n_samples, self.spatial_merge_size
         )
+        batch["patch_img_ids"] = ids
+        batch["patch_pos_hw"] = pos_hw
+        batch["patches_per_row"] = spans
         return batch

@@ -1,0 +1,210 @@
+"""CLEVR-count SFT — supervised vision-language finetuning.
+
+Behavioral counterpart of the reference's
+`examples/vlm/clevr_count_70k_sft.py`: (image, question, count) triples
+train the LM loss on the answer span, with pixels flowing through the
+vision tower exactly as in RL training (engine/vlm_engine.py).
+
+Dataset rows come from the clevr loader (areal_tpu/dataset/clevr.py):
+either an AutoProcessor patchifies images at collate time, or rows are
+pre-patchified (offline manifests with inline pixel_values +
+image_grid_thw).
+
+Launch:  python examples/vlm/clevr_sft.py --config examples/vlm/clevr_sft.yaml
+"""
+
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import SFTConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.engine.vlm_engine import JaxVLMLMEngine
+from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.models.vision import mrope_position_ids
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.data import pad_sequences_to_tensors
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+
+logger = logging.getLogger("clevr_sft")
+
+
+def tokenize_sample(sample, tokenizer, processor, model_cfg, max_length):
+    """-> token row (input_ids, loss_mask, mrope_positions) + patch arrays."""
+    if "input_ids" in sample:
+        prompt_ids = list(sample["input_ids"])
+        pv = np.asarray(sample["pixel_values"], np.float32)
+        grid = np.asarray(sample["image_grid_thw"], np.int64).reshape(-1, 3)
+    else:
+        if processor is None:
+            raise ValueError("need an AutoProcessor or pre-tokenized rows")
+        from areal_tpu.utils.image import load_images
+
+        processed = processor(
+            images=load_images(sample["images"]),
+            text=sample["messages"],
+            padding=False,
+        )
+        ids = processed["input_ids"]
+        prompt_ids = list(ids[0] if hasattr(ids[0], "__len__") else ids)
+        pv = np.asarray(processed["pixel_values"], np.float32)
+        grid = np.asarray(processed["image_grid_thw"], np.int64).reshape(-1, 3)
+    answer_ids = tokenizer.encode(
+        str(sample["answer"]), add_special_tokens=False
+    )
+    if tokenizer.eos_token_id is not None:
+        answer_ids = answer_ids + [tokenizer.eos_token_id]
+    if len(prompt_ids) >= max_length:
+        # NEVER truncate into the prompt: cutting an image-placeholder run
+        # desyncs patches from tokens (mrope would reject the row anyway)
+        return None
+    ids = (prompt_ids + answer_ids)[:max_length]
+    n_prompt = len(prompt_ids)
+    loss_mask = [0.0] * n_prompt + [1.0] * (len(ids) - n_prompt)
+    merge = model_cfg.vision.spatial_merge_size
+    mrope = mrope_position_ids(
+        np.asarray(ids, np.int64), grid, model_cfg.image_token_id,
+        spatial_merge_size=merge,
+    ).T  # [T, 3]
+    return (
+        {
+            "input_ids": np.asarray(ids, np.int32),
+            "loss_mask": np.asarray(loss_mask, np.float32),
+            "mrope_positions": mrope.astype(np.int32),
+        },
+        pv,
+        grid,
+    )
+
+
+def collate(samples, tokenizer, processor, model_cfg, max_length):
+    from areal_tpu.models.vision import patch_arrays_for_rows
+
+    rows, pv_parts, grids = [], [], []
+    for s in samples:
+        tokenized = tokenize_sample(
+            s, tokenizer, processor, model_cfg, max_length
+        )
+        if tokenized is None:
+            logger.warning("dropping over-length sample %s",
+                           s.get("query_id", "?"))
+            continue
+        row, pv, grid = tokenized
+        rows.append(row)
+        pv_parts.append(pv)
+        grids.append(grid)
+    if not rows:
+        raise ValueError(
+            "every sample in the batch exceeded max_length; raise "
+            "train_dataset.max_length"
+        )
+    batch = pad_sequences_to_tensors(rows)
+    ids, pos_hw, spans = patch_arrays_for_rows(
+        grids, model_cfg.vision.spatial_merge_size
+    )
+    batch["pixel_values"] = np.concatenate(pv_parts)
+    batch["patch_img_ids"] = ids
+    batch["patch_pos_hw"] = pos_hw
+    batch["patches_per_row"] = spans
+    return batch
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, SFTConfig)
+    seeding.set_random_seed(config.seed, "sft")
+
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(
+        config.tokenizer_path or config.model.path
+    )
+    processor = None
+    try:
+        from transformers import AutoProcessor
+
+        processor = AutoProcessor.from_pretrained(
+            config.tokenizer_path or config.model.path
+        )
+    except Exception:  # noqa: BLE001 — pre-tokenized manifests need none
+        logger.warning("no AutoProcessor; expecting pre-tokenized rows")
+
+    model_cfg = TransformerConfig.from_hf(config.model.path)
+    if model_cfg.vision is None:
+        raise ValueError(f"{config.model.path} has no vision_config")
+
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type or "clevr",
+        split="train",
+        tokenizer=tokenizer,
+        processor=processor,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    steps_per_epoch = len(dataloader)
+    total_steps = config.total_train_steps or (
+        config.total_train_epochs * steps_per_epoch
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    engine = JaxVLMLMEngine(config.model, model_config=model_cfg)
+    engine.initialize(ft_spec=ft_spec)
+    saver = Saver(config.saver, ft_spec)
+    stats_logger = StatsLogger(config.stats_logger)
+    max_len = config.train_dataset.max_length or 1024
+
+    global_step = 0
+    step_info = StepInfo(
+        global_step=0, epoch=0, epoch_step=0, steps_per_epoch=steps_per_epoch
+    )
+    for epoch in range(config.total_train_epochs):
+        for epoch_step, samples in enumerate(dataloader):
+            if global_step >= total_steps:
+                break
+            batch = collate(samples, tokenizer, processor, model_cfg, max_len)
+            with stats.DEFAULT_TRACKER.scope("sft"):
+                st = engine.train_lm(batch)
+                stats.DEFAULT_TRACKER.scalar(
+                    **{k: v for k, v in st.items() if np.isscalar(v)}
+                )
+            engine.step_lr_scheduler()
+            step_info = StepInfo(
+                global_step=global_step,
+                epoch=epoch,
+                epoch_step=epoch_step,
+                steps_per_epoch=steps_per_epoch,
+            )
+            saver.save(engine, epoch, epoch_step, global_step, tokenizer=tokenizer)
+            stats_logger.commit(
+                epoch, epoch_step, global_step,
+                [stats.DEFAULT_TRACKER.export()],
+            )
+            logger.info(
+                f"Epoch {epoch + 1}/{config.total_train_epochs} "
+                f"Step {epoch_step + 1}/{steps_per_epoch} done. "
+                f"loss={st['loss']:.4f} ppl={st['ppl']:.2f}"
+            )
+            global_step += 1
+
+    engine.save(
+        SaveLoadMeta(path=saver.save_path(step_info, "final"), tokenizer=tokenizer)
+    )
+    stats_logger.close()
+    engine.destroy()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

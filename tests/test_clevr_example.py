@@ -108,3 +108,55 @@ stats_logger:
     )
     assert "Step 1/" in trainer_log and "done." in trainer_log, trainer_log[-4000:]
     assert "Step 2/" in trainer_log, trainer_log[-4000:]
+
+
+@pytest.mark.slow
+def test_clevr_sft_example_end_to_end(tmp_path):
+    """VLM SFT entry point (reference: examples/vlm/clevr_count_70k_sft.py)
+    runs on a tiny VLM checkpoint with pre-patchified rows."""
+    ckpt = tmp_path / "model"
+    cfg_model = make_tiny_vlm_ckpt(str(ckpt))
+    data_dir = tmp_path / "clevr"
+    data_dir.mkdir()
+    make_clevr_jsonl(str(data_dir / "train.jsonl"), cfg_model, n=8)
+    fileroot = tmp_path / "exp"
+    cfg = f"""
+experiment_name: clevr-sft-smoke
+trial_name: t0
+seed: 1
+total_train_epochs: 1
+total_train_steps: 2
+tokenizer_path: {ckpt}
+cluster:
+  fileroot: {fileroot}
+train_dataset:
+  path: {data_dir}
+  type: clevr
+  batch_size: 4
+  max_length: 64
+model:
+  path: {ckpt}
+  dtype: float32
+  gradient_checkpointing: false
+  pack_length_quantum: 32
+  max_pack_length: 64
+  optimizer:
+    lr: 1.0e-4
+    warmup_steps_proportion: 0.0
+saver:
+  freq_steps: null
+stats_logger:
+  fileroot: {fileroot}
+"""
+    cfg_path = tmp_path / "cfg.yaml"
+    cfg_path.write_text(cfg)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/vlm/clevr_sft.py"),
+         "--config", str(cfg_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-3000:]
+    assert "Step 2/" in proc.stderr + proc.stdout, proc.stderr[-2000:]

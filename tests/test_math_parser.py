@@ -141,3 +141,19 @@ def test_equal(pred, target):
 )
 def test_not_equal(pred, target):
     assert not math_equal(pred, target), (pred, target)
+
+
+def test_aime_style_closed_forms():
+    """Eval-harness breadth (VERDICT r2 weak #9): decimal-vs-closed-form,
+    binomials, and bare 'Answer:' lines the AIME/AMC sets need."""
+    from areal_tpu.reward.math_parser import extract_answer, math_equal
+
+    assert math_equal(r"\frac{1+\sqrt{5}}{2}", "1.6180339887")
+    assert math_equal("1.6180339887", r"\frac{1+\sqrt{5}}{2}")
+    assert math_equal(r"\binom{10}{3}", "120")
+    assert math_equal(r"\dbinom{5}{2}", "10")
+    assert math_equal(r"2\sqrt{3}", "3.4641016")
+    assert not math_equal(r"2\sqrt{3}", "3.5")
+    assert not math_equal(r"\frac{m}{n}", "1.5")  # free symbols stay symbolic
+    assert extract_answer("Answer: 042") == "042"
+    assert math_equal("042", "42")
