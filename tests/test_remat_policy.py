@@ -26,10 +26,28 @@ def test_dots_policy_matches_full():
         return jax.value_and_grad(f)(params)
 
     l_full, g_full = loss(base.replace(remat=True, remat_policy="full"))
-    l_dots, g_dots = loss(base.replace(remat=True, remat_policy="dots"))
-    np.testing.assert_allclose(float(l_full), float(l_dots), rtol=1e-6)
-    jax.tree_util.tree_map(
-        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
-        g_full,
-        g_dots,
-    )
+    for policy in ("dots", "save_attn"):
+        l_p, g_p = loss(base.replace(remat=True, remat_policy=policy))
+        np.testing.assert_allclose(float(l_full), float(l_p), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            g_full,
+            g_p,
+        )
+
+
+def test_scan_unroll_matches_rolled():
+    base = tiny_config(vocab_size=64, qkv_bias=True, dtype="float32",
+                       param_dtype="float32", num_layers=4)
+    params = init_params(base, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(1)
+    B, L = 2, 16
+    ids = rng.integers(0, 64, (B, L)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
+    seg = np.zeros((B, L), np.int32)
+    outs = []
+    for unroll in (1, 2, 4, 3):  # 3 does not divide 4 -> falls back to 1
+        cfg = base.replace(scan_unroll=unroll)
+        outs.append(np.asarray(forward(params, cfg, ids, pos, seg)))
+    for o in outs[1:]:
+        np.testing.assert_allclose(outs[0], o, rtol=1e-6)
