@@ -238,7 +238,9 @@ class GenServer:
             if entry["dtype"] == "bfloat16"
             else np.dtype(entry["dtype"])
         )
-        return np.frombuffer(bytes(entry["buf"]), dtype=dtype).reshape(
+        # view straight over the staged bytearray — bytes(...) would copy
+        # the whole model a second time on the commit path
+        return np.frombuffer(entry["buf"], dtype=dtype).reshape(
             entry["shape"]
         )
 

@@ -174,7 +174,7 @@ def state_to_params(
                 if transpose:
                     arr = arr.T
                 buf = vision_layer_buf(path_in_layer, arr.shape)
-                buf[idx] = arr.astype(np_dtype)
+                buf[idx] = arr  # assignment casts; no intermediate copy
                 vision_fill[path_in_layer] = vision_fill.get(path_in_layer, 0) + 1
             elif name == "visual.patch_embed.proj.weight":
                 # Conv3d [D, C, tps, ps, ps] -> matmul [patch_dim, D]
@@ -199,7 +199,7 @@ def state_to_params(
             if transpose:
                 arr = arr.T
             buf = layer_buf(path_in_layer, arr.shape)
-            buf[idx] = arr.astype(np_dtype)
+            buf[idx] = arr  # assignment casts; no intermediate copy
             fill_count[path_in_layer] = fill_count.get(path_in_layer, 0) + 1
         elif name == "model.embed_tokens.weight":
             params["embedding"] = arr.astype(np_dtype)

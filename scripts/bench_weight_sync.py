@@ -81,6 +81,9 @@ def main():
         model_config=cfg,
     )
     trainer.initialize(ft_spec=None)
+    # settle async param initialisation: measuring from here would charge
+    # jit-init wait time to the transfer path
+    jax.block_until_ready(trainer.params)
     os.environ["AREAL_LLM_SERVER_ADDRS"] = addr
     meta = WeightUpdateMeta.from_transfer("wsync", "t")
     t0 = time.perf_counter()
