@@ -153,7 +153,7 @@ def state_to_params(
 
     seen_head = False
     for name, arr in items:
-        arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; astype below handles it
+        arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; the cast-on-assignment into the stacked buffers handles it
         # newer transformers nest the decoder/tower under model.*
         if name.startswith("model.language_model."):
             name = "model." + name[len("model.language_model."):]

