@@ -106,6 +106,12 @@ class TrainEngine(abc.ABC):
     def update_weights(self, meta: WeightUpdateMeta) -> None:
         """Push current weights to inference servers (disk or transfer path)."""
 
+    def stage_weights(self, meta: WeightUpdateMeta) -> None:
+        """Optionally pre-run the expensive half of a weight publish while
+        generation still runs (snapshot write / chunk streaming), so only
+        the swap sits inside the pause window; update_weights() then skips
+        the staged work.  Default: no-op (update_weights does everything)."""
+
     @abc.abstractmethod
     def save(self, meta: SaveLoadMeta) -> None: ...
 

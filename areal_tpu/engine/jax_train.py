@@ -98,6 +98,7 @@ class JaxTrainEngine(TrainEngine):
         self._forward_cache: Dict[Tuple, Callable] = {}
         self._ft_spec: Optional[FinetuneSpec] = None
         self._transfer_executor = None  # lazy: weight-transfer push thread
+        self._staged = None  # (meta.type, version) staged by stage_weights
         self.last_weight_update_seconds: Optional[float] = None
         self.initialized = False
         # the jitted step functions call self._model_fn(params, cfg, ids,
@@ -607,14 +608,45 @@ class JaxTrainEngine(TrainEngine):
           server (`/update_weights_chunk`), then commit.  No shared
           filesystem in the loop.
         """
+        try:
+            if meta.type == "disk":
+                if self._staged != ("disk", self._version):
+                    self._write_disk_snapshot(meta)
+                if distributed.is_head():
+                    name_resolve.add(
+                        names.update_weights_from_disk(
+                            meta.experiment_name, meta.trial_name, self._version
+                        ),
+                        str(time.time_ns()),
+                        replace=True,
+                    )
+            elif meta.type == "transfer":
+                self._update_weights_transfer(meta)
+            else:
+                raise NotImplementedError(f"weight update type {meta.type!r}")
+        finally:
+            # ALWAYS consume the staged marker: a failed commit (e.g. a
+            # server restarted and lost its staged chunks -> 409) must make
+            # the retry re-push rather than skip to another doomed commit
+            self._staged = None
+
+    def stage_weights(self, meta: WeightUpdateMeta) -> None:
+        """Run the EXPENSIVE half of a weight publish while generation is
+        still running, so only the cheap commit sits inside the pause
+        window: disk = export + snapshot write (publication of the
+        name_resolve version key waits for update_weights); transfer =
+        export + chunk streaming into the servers' staging buffers (the
+        swap waits for the commit).  Call with the same version that
+        update_weights will publish."""
         if meta.type == "disk":
-            self._update_weights_disk(meta)
+            self._write_disk_snapshot(meta)
         elif meta.type == "transfer":
-            self._update_weights_transfer(meta)
+            self._push_transfer_chunks(meta)
         else:
             raise NotImplementedError(f"weight update type {meta.type!r}")
+        self._staged = (meta.type, self._version)
 
-    def _update_weights_disk(self, meta: WeightUpdateMeta) -> None:
+    def _write_disk_snapshot(self, meta: WeightUpdateMeta) -> None:
         final = os.path.join(meta.path, f"v{self._version}")
         tmp = os.path.join(meta.path, f".tmp-v{self._version}-{os.getpid()}")
         if distributed.is_head():
@@ -632,13 +664,6 @@ class JaxTrainEngine(TrainEngine):
                 shutil.rmtree(final)
             os.rename(tmp, final)
             self._prune_weight_dirs(meta.path, keep=2)
-            name_resolve.add(
-                names.update_weights_from_disk(
-                    meta.experiment_name, meta.trial_name, self._version
-                ),
-                str(time.time_ns()),
-                replace=True,
-            )
         else:
             self._host_params()  # participate in the replication collectives
 
@@ -674,32 +699,49 @@ class JaxTrainEngine(TrainEngine):
             time.sleep(0.5)
 
     def _update_weights_transfer(self, meta: WeightUpdateMeta) -> None:
-        """Chunk-streamed push: each HF-named array is sliced into
-        <= chunk_mb byte pieces, POSTed to every server as raw
-        `application/octet-stream` bodies (name/dtype/shape/offset in
-        X-Weight-* headers — no base64 inflation or per-chunk json parse),
-        then committed (server assembles by (name, offset) — gen/server.py).
+        """Chunk-streamed push + commit (reference NCCL-broadcast intent,
+        fsdp_engine.py:298-401, over HTTP/DCN).  With a prior
+        `stage_weights` call the chunks already sit in the servers'
+        staging buffers and only the commit (weight swap) runs here.  The
+        measured wall time lands in `self.last_weight_update_seconds`."""
+        t0 = time.perf_counter()
+        if self._staged != ("transfer", self._version):
+            self._push_transfer_chunks(meta)
+        self._commit_transfer(meta)
+        self.last_weight_update_seconds = time.perf_counter() - t0
 
-        The asyncio push runs on a dedicated transfer thread, not the
-        caller's (the trainer thread may own its own event loop); the call
-        still blocks until the fleet commits — pause→update→resume is a
-        synchronous control-plane action.  The measured wall time lands in
-        `self.last_weight_update_seconds`."""
+    def _run_on_transfer_thread(self, coro) -> None:
+        """Run an asyncio coroutine on the dedicated transfer thread (the
+        caller thread may own its own event loop) and block on it —
+        weight publication is a synchronous control-plane action."""
+        import asyncio
+
+        if self._transfer_executor is None:
+            import concurrent.futures
+
+            self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="weight-transfer"
+            )
+        self._transfer_executor.submit(asyncio.run, coro).result()
+
+    def _push_transfer_chunks(self, meta: WeightUpdateMeta) -> None:
+        """Stream every HF-named array, sliced into <= chunk_mb pieces, as
+        raw `application/octet-stream` bodies (name/dtype/shape/offset in
+        X-Weight-* headers — no base64 inflation or per-chunk json parse)
+        into every server's staging buffer (gen/server.py assembles by
+        (name, offset)).  Does NOT swap weights — safe while the servers
+        are still generating."""
         import asyncio
         import json as _json
 
         import ml_dtypes
 
         from areal_tpu.models.hf import params_to_hf_state
-        from areal_tpu.utils.http import (
-            apost_bytes_with_retry,
-            arequest_with_retry,
-        )
+        from areal_tpu.utils.http import apost_bytes_with_retry
 
         host = self._export_params()
         if not distributed.is_head():
             return
-        t0 = time.perf_counter()
         addrs = self._server_addrs(meta)
         bf16 = np.dtype(ml_dtypes.bfloat16)
         chunk_bytes = max(1, meta.chunk_mb) << 20
@@ -711,7 +753,6 @@ class JaxTrainEngine(TrainEngine):
             for name, arr in params_to_hf_state(host, self.model_config)
         ]
         del host
-        version = self._version
 
         async def push(addr: str):
             import aiohttp
@@ -738,26 +779,36 @@ class JaxTrainEngine(TrainEngine):
                             timeout=300.0,
                             session=session,
                         )
-                await arequest_with_retry(
-                    addr=addr,
-                    endpoint="/update_weights_chunk",
-                    payload={"commit": True, "version": version},
-                    method="POST",
-                    timeout=600.0,
-                    session=session,
-                )
 
         async def run():
             await asyncio.gather(*[push(a) for a in addrs])
 
-        if self._transfer_executor is None:
-            import concurrent.futures
+        self._run_on_transfer_thread(run())
 
-            self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="weight-transfer"
-            )
-        self._transfer_executor.submit(asyncio.run, run()).result()
-        self.last_weight_update_seconds = time.perf_counter() - t0
+    def _commit_transfer(self, meta: WeightUpdateMeta) -> None:
+        """Swap the staged weights in on every server."""
+        import asyncio
+
+        from areal_tpu.utils.http import arequest_with_retry
+
+        if not distributed.is_head():
+            return
+        addrs = self._server_addrs(meta)
+        version = self._version
+
+        async def run():
+            await asyncio.gather(*[
+                arequest_with_retry(
+                    addr=a,
+                    endpoint="/update_weights_chunk",
+                    payload={"commit": True, "version": version},
+                    method="POST",
+                    timeout=600.0,
+                )
+                for a in addrs
+            ])
+
+        self._run_on_transfer_thread(run())
 
     def save(self, meta: SaveLoadMeta) -> None:
         """Model weights as an HF safetensors dir (interop with inference

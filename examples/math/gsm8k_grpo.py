@@ -238,8 +238,11 @@ def main(argv):
             actor.step_lr_scheduler()
 
         with stats.record_timing("update_weights"):
-            rollout.pause()
+            # the expensive half (snapshot write / chunk streaming) runs
+            # while generation continues; only the swap needs the pause
             actor.set_version(global_step + 1)
+            actor.stage_weights(weight_meta)
+            rollout.pause()
             actor.update_weights(weight_meta)
             rollout.update_weights(weight_meta)
             rollout.set_version(global_step + 1)

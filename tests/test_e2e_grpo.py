@@ -259,3 +259,91 @@ def test_grpo_transfer_weight_sync(tmp_path):
     finally:
         server.shutdown.set()
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_staged_weight_sync_splits_push_from_commit(tmp_path):
+    """stage_weights streams chunks while the server is un-paused and does
+    NOT swap weights; the later update_weights commit is the only part
+    that needs the pause window (docs/perf.md round-4 lever, now wired)."""
+    import urllib.request
+
+    import jax
+
+    from areal_tpu.utils import name_resolve, names
+
+    ckpt0 = tmp_path / "init"
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    save_hf_checkpoint(params, CFG, str(ckpt0), save_dtype="float32")
+    engine = GenEngine(CFG.replace(dtype="float32"), model_path=str(ckpt0),
+                       n_slots=4, max_seq_len=96, prompt_bucket=16)
+    server = GenServer(engine)
+    server.start()
+    port = network.find_free_port()
+    loop = asyncio.new_event_loop()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(server.app())
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    for _ in range(100):
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.1)
+    name_resolve.add(
+        names.gen_server("e2e-st", "t", "0"), f"127.0.0.1:{port}", replace=True
+    )
+    actor = JaxPPOActor(
+        PPOActorConfig(
+            experiment_name="e2e-st", trial_name="t", path=str(ckpt0),
+            dtype="float32", gradient_checkpointing=False,
+            mesh=MeshConfig(), mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=5e-3, warmup_steps_proportion=0.0),
+            pack_length_quantum=32, max_pack_length=96,
+            group_size=2, ppo_n_minibatches=1,
+        ),
+    )
+    actor.initialize(ft_spec=FinetuneSpec(1, 16, 4))
+    try:
+        meta = WeightUpdateMeta.from_transfer("e2e-st", "t", chunk_mb=1)
+        actor.set_version(1)
+        actor.stage_weights(meta)
+        # staged but NOT swapped: server still serves version 0 un-paused
+        assert engine.version == 0
+        assert server._chunk_buf, "chunks must be staged server-side"
+        assert not server.paused.is_set()
+        t0 = time.perf_counter()
+        actor.update_weights(meta)  # commit only
+        commit_s = time.perf_counter() - t0
+        assert engine.version == 1
+        assert not server._chunk_buf  # consumed by the commit
+        # staged state is single-use: a second update re-pushes
+        actor.set_version(2)
+        actor.update_weights(meta)
+        assert engine.version == 2
+        print(f"staged commit: {commit_s*1e3:.0f}ms")
+
+        # disk path staging: snapshot written before publish
+        weight_dir = tmp_path / "updates"
+        weight_dir.mkdir()
+        meta_d = WeightUpdateMeta(type="disk", path=str(weight_dir),
+                                  experiment_name="e2e-st", trial_name="t")
+        actor.set_version(3)
+        actor.stage_weights(meta_d)
+        assert (weight_dir / "v3").is_dir()
+        key = names.update_weights_from_disk("e2e-st", "t", 3)
+        try:
+            name_resolve.get(key)
+            raise AssertionError("version published before update_weights")
+        except name_resolve.NameEntryNotFoundError:
+            pass
+        actor.update_weights(meta_d)
+        assert name_resolve.get(key)
+    finally:
+        server.shutdown.set()
+        loop.call_soon_threadsafe(loop.stop)
