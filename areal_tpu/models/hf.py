@@ -65,6 +65,17 @@ _VISION_LAYER_MAP = {
     "mlp.down_proj.weight": (("w_down",), True),
     "mlp.down_proj.bias": (("b_down",), False),
 }
+# MoE per-layer names: qwen-MoE (mlp.experts.N.*_proj + mlp.gate router)
+# and mixtral (block_sparse_moe.experts.N.w{1,2,3} + block_sparse_moe.gate)
+_MOE_EXPERT_RE = re.compile(
+    r"(?:mlp|block_sparse_moe)\.experts\.(\d+)\.(gate_proj|up_proj|down_proj|w1|w2|w3)\.weight"
+)
+_MOE_ROUTER_NAMES = ("mlp.gate.weight", "block_sparse_moe.gate.weight")
+_MOE_LEAF = {
+    "gate_proj": "w_gate", "up_proj": "w_up", "down_proj": "w_down",
+    "w1": "w_gate", "w3": "w_up", "w2": "w_down",
+}
+
 # read-only aliases: this repo's pre-r3 checkpoints used short mlp names
 _VISION_LAYER_ALIASES = {
     "mlp.up.weight": (("w_up",), True),
@@ -130,6 +141,8 @@ def state_to_params(
     np_dtype = np.dtype(dtype)
     params: Dict[str, Any] = {"layers": {}}
     fill_count: Dict[Tuple[str, ...], int] = {}
+    # expected writes per path: L for dense leaves, L*E for expert stacks
+    fill_expected: Dict[Tuple[str, ...], int] = {}
 
     def layer_buf(path_in_layer: Tuple[str, ...], shape):
         try:
@@ -192,15 +205,38 @@ def state_to_params(
         m = _LAYER_RE.match(name)
         if m:
             idx, suffix = int(m.group(1)), m.group(2)
-            if suffix not in _LAYER_MAP:
-                logger.warning("skipping unmapped weight %s", name)
+            if suffix in _LAYER_MAP:
+                path_in_layer, transpose = _LAYER_MAP[suffix]
+                if transpose:
+                    arr = arr.T
+                buf = layer_buf(path_in_layer, arr.shape)
+                buf[idx] = arr  # assignment casts; no intermediate copy
+                fill_count[path_in_layer] = fill_count.get(path_in_layer, 0) + 1
                 continue
-            path_in_layer, transpose = _LAYER_MAP[suffix]
-            if transpose:
-                arr = arr.T
-            buf = layer_buf(path_in_layer, arr.shape)
-            buf[idx] = arr  # assignment casts; no intermediate copy
-            fill_count[path_in_layer] = fill_count.get(path_in_layer, 0) + 1
+            if cfg.num_experts > 0:
+                em = _MOE_EXPERT_RE.fullmatch(suffix)
+                if em:
+                    e, leaf = int(em.group(1)), _MOE_LEAF[em.group(2)]
+                    path_in_layer = ("moe", leaf)
+                    buf = layer_buf(
+                        path_in_layer, (cfg.num_experts, *arr.T.shape)
+                    )
+                    buf[idx, e] = arr.T
+                    fill_count[path_in_layer] = (
+                        fill_count.get(path_in_layer, 0) + 1
+                    )
+                    fill_expected[path_in_layer] = L * cfg.num_experts
+                    continue
+                if suffix in _MOE_ROUTER_NAMES:
+                    # HF router Linear [E, D] -> ours [D, E]
+                    path_in_layer = ("moe", "router")
+                    buf = layer_buf(path_in_layer, arr.T.shape)
+                    buf[idx] = arr.T
+                    fill_count[path_in_layer] = (
+                        fill_count.get(path_in_layer, 0) + 1
+                    )
+                    continue
+            logger.warning("skipping unmapped weight %s", name)
         elif name == "model.embed_tokens.weight":
             params["embedding"] = arr.astype(np_dtype)
         elif name == "model.norm.weight":
@@ -211,10 +247,11 @@ def state_to_params(
         else:
             logger.warning("skipping unmapped weight %s", name)
     for path_in_layer, n in fill_count.items():
-        if n != L:
+        want = fill_expected.get(path_in_layer, L)
+        if n != want:
             raise ValueError(
                 f"incomplete weights: {'.'.join(path_in_layer)} filled for "
-                f"{n}/{L} layers"
+                f"{n}/{want} slots"
             )
     for required in ("embedding", "final_norm"):
         if required not in params:
@@ -266,6 +303,13 @@ def params_to_hf_state(
     """Yield HF-named (name, array) pairs from the stacked pytree."""
     yield "model.embed_tokens.weight", np.asarray(params["embedding"])
     layers = params["layers"]
+    mixtral = cfg.hf_architecture == "MixtralForCausalLM"
+    moe_prefix = "block_sparse_moe" if mixtral else "mlp"
+    moe_names = (
+        {"w_gate": "w1", "w_up": "w3", "w_down": "w2"}
+        if mixtral
+        else {"w_gate": "gate_proj", "w_up": "up_proj", "w_down": "down_proj"}
+    )
     for i in range(cfg.num_layers):
         prefix = f"model.layers.{i}."
         for suffix, (path_in_layer, transpose) in _LAYER_MAP.items():
@@ -277,6 +321,19 @@ def params_to_hf_state(
             if transpose:
                 arr = arr.T
             yield prefix + suffix, arr
+        if "moe" in layers:
+            moe = layers["moe"]
+            yield (
+                f"{prefix}{moe_prefix}.gate.weight",
+                np.asarray(moe["router"][i]).T,
+            )
+            for leaf, hf_leaf in moe_names.items():
+                buf = np.asarray(moe[leaf][i])  # [E, D, F] / [E, F, D]
+                for e in range(cfg.num_experts):
+                    yield (
+                        f"{prefix}{moe_prefix}.experts.{e}.{hf_leaf}.weight",
+                        buf[e].T,
+                    )
     yield "model.norm.weight", np.asarray(params["final_norm"])
     if "lm_head" in params:
         yield "lm_head.weight", np.asarray(params["lm_head"]).T

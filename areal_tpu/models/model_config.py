@@ -145,6 +145,23 @@ class TransformerConfig:
             qkv_bias = bool(d.get("attention_bias", False))
             qk_norm = True
         num_heads = d["num_attention_heads"]
+        n_experts = d.get("num_local_experts", d.get("num_experts", 0)) or 0
+        if (
+            n_experts > 0
+            and model_type.startswith("qwen")
+            and not d.get("norm_topk_prob", False)
+        ):
+            # this repo's router always renormalizes top-k gates (the
+            # mixtral/released-qwen-moe convention); a checkpoint trained
+            # with norm_topk_prob=false has different routing semantics
+            import warnings
+
+            warnings.warn(
+                "checkpoint config has norm_topk_prob=false but this "
+                "runtime renormalizes top-k gates — routing semantics "
+                "will diverge from the original model",
+                stacklevel=2,
+            )
         eos = d.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
@@ -208,6 +225,8 @@ class TransformerConfig:
             "Qwen2ForCausalLM": "qwen2",
             "Qwen3ForCausalLM": "qwen3",
             "MistralForCausalLM": "mistral",
+            "Qwen3MoeForCausalLM": "qwen3_moe",
+            "MixtralForCausalLM": "mixtral",
         }.get(arch, "llama")
         d = {
             "architectures": [arch],
@@ -229,8 +248,15 @@ class TransformerConfig:
         }
         if self.head_dim is not None:
             d["head_dim"] = self.head_dim
-        if model_type in ("qwen2", "qwen3", "mistral", "llama"):
+        if model_type in ("qwen2", "qwen3", "mistral", "llama", "qwen3_moe"):
             d["attention_bias"] = self.qkv_bias
+        if self.num_experts > 0:
+            key = "num_local_experts" if model_type == "mixtral" else "num_experts"
+            d[key] = self.num_experts
+            d["num_experts_per_tok"] = self.num_experts_per_tok
+            d["norm_topk_prob"] = True  # the routing this repo computes
+            if self.moe_intermediate_size is not None:
+                d["moe_intermediate_size"] = self.moe_intermediate_size
         if self.sliding_window is not None:
             d["sliding_window"] = self.sliding_window
             d["use_sliding_window"] = True

@@ -197,11 +197,13 @@ def main(argv):
             train_stats = actor.ppo_update(batch)
             actor.step_lr_scheduler()
 
-        with stats.record_timing("update_weights"):
-            # the expensive half (snapshot write / chunk streaming) runs
-            # while generation continues; only the swap needs the pause
+        # the expensive half (snapshot write / chunk streaming) runs while
+        # generation continues; only the swap needs the pause — timed
+        # separately so the pause-window cost stays visible in the stats
+        with stats.record_timing("stage_weights"):
             actor.set_version(global_step + 1)
             actor.stage_weights(weight_meta)
+        with stats.record_timing("update_weights"):
             rollout.pause()
             actor.update_weights(weight_meta)
             rollout.update_weights(weight_meta)

@@ -204,7 +204,7 @@ def test_checkpoint_watcher_picks_up_trainer_publishes(fleet, tmp_path):
     h = RouterHarness(router)
     raddr = h.start()
     try:
-        # trainer publishes version 3 (key layout from jax_train._update_weights_disk)
+        # trainer publishes version 3 (key layout from JaxTrainEngine.update_weights)
         name_resolve.add(
             names.update_weights_from_disk("rtest", "t0", 3), "123", replace=True
         )
