@@ -44,16 +44,22 @@ def _get_pool() -> ProcessPoolExecutor:
 def _warm_async(pool: ProcessPoolExecutor) -> None:
     """Kick one noop per worker and flip _pool_warm only when ALL complete:
     warmth is per-worker — a single fast reward on worker 1 proves nothing
-    about worker 3 still importing jax."""
+    about worker 3 still importing jax.  The callback re-checks that `pool`
+    is still the CURRENT pool (ADVICE r3): in-flight noops from a pool
+    replaced by _recreate_pool must not mark the cold replacement warm."""
     remaining = [_MAX_WORKERS]
     lock = threading.Lock()
 
-    def _done(_):
+    def _done(fut):
         global _pool_warm
+        if fut.cancelled() or fut.exception() is not None:
+            return  # a dead pool's noop proves nothing
         with lock:
             remaining[0] -= 1
             if remaining[0] == 0:
-                _pool_warm = True
+                with _pool_lock:
+                    if _pool is pool:
+                        _pool_warm = True
 
     try:
         for _ in range(_MAX_WORKERS):
@@ -91,7 +97,12 @@ def _recreate_pool():
             _pool.shutdown(wait=False, cancel_futures=True)
         _pool = _new_pool()
         _pool_warm = False
-        return _pool
+        pool = _pool
+    # warm-kick the replacement OUTSIDE the lock (ADVICE r3: without it
+    # _pool_warm stays False forever and every timeout after a pool break
+    # is inflated to the 120s bootstrap allowance)
+    _warm_async(pool)
+    return pool
 
 
 class AsyncRewardWrapper:

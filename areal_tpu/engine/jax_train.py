@@ -708,6 +708,7 @@ class JaxTrainEngine(TrainEngine):
         if self._staged != ("transfer", self._version):
             self._push_transfer_chunks(meta)
         self._commit_transfer(meta)
+        self._notify_router(meta)
         self.last_weight_update_seconds = time.perf_counter() - t0
 
     def _run_on_transfer_thread(self, coro) -> None:
@@ -809,6 +810,31 @@ class JaxTrainEngine(TrainEngine):
             ])
 
         self._run_on_transfer_thread(run())
+
+    def _notify_router(self, meta: WeightUpdateMeta) -> None:
+        """Transfer publishes leave no disk checkpoint for a router's
+        watcher to see, so its fleet staleness gate needs the version pushed
+        explicitly (ADVICE r3: the gate's budget otherwise never grows and
+        admission wedges at 409).  Best-effort: the router also polls the
+        backends' served version as a safety net."""
+        if not distributed.is_head():
+            return
+        try:
+            addr = name_resolve.get(
+                names.gen_router(meta.experiment_name, meta.trial_name)
+            )
+        except Exception:  # noqa: BLE001 — no router in this deployment
+            return
+        try:
+            import requests
+
+            requests.post(
+                f"http://{addr}/set_version",
+                json={"version": self._version},
+                timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001 — poller covers the miss
+            logger.warning(f"router /set_version failed (poll covers it): {e}")
 
     def save(self, meta: SaveLoadMeta) -> None:
         """Model weights as an HF safetensors dir (interop with inference

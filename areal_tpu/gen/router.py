@@ -16,6 +16,15 @@ front of N generation servers that
   -> resume all, bumping the served version (check_new_params
   gserver_manager.py:131, flush_requests_and_update_weights :158).
 
+The staleness gate's capacity formula depends on the trainer's weight
+version, so the router needs a version source in EVERY deployment mode
+(ADVICE r3): disk fleets advance via the checkpoint watcher; transfer-mode
+fleets (trainer pushes chunks straight to servers) advance via POST
+/set_version from the train loop, with a background poll of the backends'
+/health version as a safety net — the transfer commit bumps each server's
+served version, so the fleet's max is adopted even if the trainer never
+calls /set_version.
+
 Clients need no new protocol: the router speaks the same wire format as a
 generation server (areal_tpu/gen/server.py), so RemoteInfEngine can point at
 the router exactly as it would at one big server.
@@ -50,6 +59,10 @@ class RouterConfig:
     # checkpoint watcher
     weights_path: str = ""  # trainer's WeightUpdateMeta.path; ckpts at v{N}/
     poll_interval: float = 1.0
+    # transfer-mode version safety net: poll backend /health and adopt the
+    # fleet's max served version (0 disables; only runs when the staleness
+    # gate is enabled and no disk watcher owns the version)
+    version_poll_interval: float = 2.0
     request_timeout: float = 3600.0
     # allocations older than this are reclaimed, so a client that crashed
     # mid-episode cannot permanently wedge fleet admission (0 => request_timeout)
@@ -74,6 +87,7 @@ class Router:
         self._flush_lock = asyncio.Lock()
         self._session: Optional[aiohttp.ClientSession] = None
         self._watcher: Optional[asyncio.Task] = None
+        self._version_poller: Optional[asyncio.Task] = None
         self.n_flushes = 0
 
     # ---------------------------- scheduling ----------------------------
@@ -201,6 +215,17 @@ class Router:
         )
         return web.json_response({"ok": True, "version": version})
 
+    async def set_version(self, request: web.Request) -> web.Response:
+        """Trainer-pushed version signal for transfer-mode fleets, where no
+        disk checkpoint exists for the watcher to see (ADVICE r3): without
+        it the staleness gate's budget (offpolicyness + version + 1) * bs
+        never grows and admission wedges at 409 forever."""
+        body = await request.json()
+        version = int(body["version"])
+        async with self._lock:
+            self.version = max(self.version, version)
+        return web.json_response({"ok": True, "version": self.version})
+
     async def pause(self, request: web.Request) -> web.Response:
         await self._fanout("/pause_generation", {})
         return web.json_response({"ok": True})
@@ -296,6 +321,38 @@ class Router:
                         f"{len(self.addresses)} servers")
             return self.version
 
+    async def _poll_backend_versions(self):
+        """Transfer-mode safety net: the binary-chunk commit bumps each gen
+        server's served version (gen/server.py /health reports it), so
+        adopting the fleet's max keeps the staleness gate's budget moving
+        even when the trainer never POSTs /set_version."""
+        while True:
+            await asyncio.sleep(self.config.version_poll_interval)
+            try:
+                async def probe(a: str) -> int:
+                    try:
+                        async with self._session.get(
+                            f"http://{a}/health",
+                            timeout=aiohttp.ClientTimeout(total=5),
+                        ) as resp:
+                            return int((await resp.json()).get("version", 0))
+                    except Exception:  # noqa: BLE001 — unreachable = no info
+                        return 0
+
+                versions = await asyncio.gather(
+                    *[probe(a) for a in self.addresses]
+                )
+                newest = max(versions, default=0)
+                async with self._lock:
+                    if newest > self.version:
+                        logger.info(
+                            f"adopting fleet version v{newest} from backend "
+                            "health (transfer-mode publish)"
+                        )
+                        self.version = newest
+            except Exception:  # noqa: BLE001 — poller must survive blips
+                logger.exception("backend version poll failed")
+
     async def _watch_checkpoints(self):
         """Poll name_resolve for trainer-published weight versions newer than
         what the fleet serves (reference check_new_params,
@@ -334,6 +391,15 @@ class Router:
         self._tokens = {a: 0 for a in self.addresses}
         if self.config.weights_path and self.config.experiment_name:
             self._watcher = asyncio.create_task(self._watch_checkpoints())
+        elif (
+            self.config.train_batch_size > 0
+            and self.config.version_poll_interval > 0
+        ):
+            # gate enabled with no disk watcher: transfer-mode deployment —
+            # the gate needs SOME version source or admission wedges
+            self._version_poller = asyncio.create_task(
+                self._poll_backend_versions()
+            )
         logger.info(f"router over {len(self.addresses)} servers: {self.addresses}")
 
     async def _discover(self, timeout: float = 300.0) -> List[str]:
@@ -351,6 +417,8 @@ class Router:
     async def on_cleanup(self, app):
         if self._watcher is not None:
             self._watcher.cancel()
+        if self._version_poller is not None:
+            self._version_poller.cancel()
         if self._session is not None:
             await self._session.close()
 
@@ -360,6 +428,7 @@ class Router:
         app.router.add_post("/allocate_request", self.allocate_request)
         app.router.add_post("/finish_request", self.finish_request)
         app.router.add_post("/update_weights", self.update_weights)
+        app.router.add_post("/set_version", self.set_version)
         app.router.add_post("/pause_generation", self.pause)
         app.router.add_post("/continue_generation", self.resume)
         app.router.add_get("/health", self.health)

@@ -32,6 +32,12 @@ class VisionConfig:
     spatial_merge_size: int = 2  # 2x2 patches -> one embedding
     out_hidden_size: int = 4096  # text model width
     rms_norm_eps: float = 1e-6
+    # Qwen2.5-VL windowed attention: blocks NOT in fullatt_block_indexes
+    # attend only within window_size x window_size pixel tiles of their
+    # image.  window_size == 0 means full attention in every block
+    # (Qwen2-VL behavior).
+    window_size: int = 0
+    fullatt_block_indexes: tuple = ()
 
     @property
     def patch_dim(self) -> int:
@@ -69,6 +75,13 @@ class TransformerConfig:
     moe_intermediate_size: Optional[int] = None
     moe_capacity_factor: float = 1.25  # per-expert token budget multiplier
     moe_aux_coef: float = 0.01  # Switch load-balance loss coefficient
+    # "dropless": exact HF Mixtral/Qwen3-MoE semantics — every routed token
+    # reaches its expert (sort + lax.ragged_dot grouped GEMM).  "capacity":
+    # GShard capacity-bounded dense dispatch (tokens beyond the per-expert
+    # budget are dropped under routing imbalance; cheapest under ep
+    # sharding).  HF-loaded checkpoints default to dropless so logits match
+    # the source model regardless of batch size (ADVICE r3).
+    moe_impl: str = "capacity"  # capacity | dropless
 
     # LoRA (0 = off); targets use HF module names (models/lora.py TARGET_MAP)
     lora_rank: int = 0
@@ -187,6 +200,10 @@ class TransformerConfig:
             num_experts=d.get("num_local_experts", d.get("num_experts", 0)) or 0,
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
             moe_intermediate_size=d.get("moe_intermediate_size"),
+            # real HF MoE checkpoints (mixtral/qwen3-moe) are dropless;
+            # running them through the capacity path silently drops tokens
+            # under routing imbalance and makes logits batch-size-dependent
+            moe_impl="dropless" if n_experts > 0 else "capacity",
             hf_architecture=arch,
             bos_token_id=d.get("bos_token_id", 1),
             eos_token_id=eos,
@@ -203,6 +220,10 @@ class TransformerConfig:
                     num_heads=vd.get("num_heads", 16),
                     spatial_merge_size=vd.get("spatial_merge_size", 2),
                     out_hidden_size=vd.get("out_hidden_size", d["hidden_size"]),
+                    window_size=vd.get("window_size", 0) or 0,
+                    fullatt_block_indexes=tuple(
+                        vd.get("fullatt_block_indexes", ()) or ()
+                    ),
                 )
                 if (vd := d.get("vision_config")) is not None
                 else None
@@ -273,6 +294,11 @@ class TransformerConfig:
                 "spatial_merge_size": v.spatial_merge_size,
                 "out_hidden_size": v.out_hidden_size,
             }
+            if v.window_size:
+                d["vision_config"]["window_size"] = v.window_size
+                d["vision_config"]["fullatt_block_indexes"] = list(
+                    v.fullatt_block_indexes
+                )
             if self.image_token_id is not None:
                 d["image_token_id"] = self.image_token_id
             if self.mrope_section is not None:

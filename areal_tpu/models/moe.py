@@ -6,11 +6,14 @@ Megatron EP path, areal/engine/megatron_engine.py:451-535;
 alloc grammar e/etp dims, areal/api/alloc_mode.py:80-117).  TPU-first
 design:
 
-- **Dense dispatch/combine tensors** ([tokens, E, C] one-hot): token
-  routing becomes three einsums that XLA tiles straight onto the MXU —
+- **Two dispatch implementations** behind one `moe_ffn` entry point:
+  "capacity" uses dense dispatch/combine tensors ([tokens, E, C] one-hot)
+  so routing becomes three einsums that XLA tiles straight onto the MXU —
   replacing the reference's grouped-GEMM CUDA kernels and permutation
-  indices.  Capacity C bounds each expert's work, keeping every shape
-  static under jit.
+  indices, with capacity C bounding each expert's work; "dropless" sorts
+  assignments by expert and runs `lax.ragged_dot` grouped GEMMs (the
+  MegaBlocks shape), reproducing HF Mixtral/Qwen3-MoE exactly — loaded
+  checkpoints default to it (model_config.from_hf_dict).
 - Expert weights live as [E, D, F] leaves sharded over the mesh's `ep`
   axis (partition specs in transformer.param_partition_specs); the
   dispatch einsum's contraction over tokens is what GSPMD turns into the
@@ -38,25 +41,89 @@ def expert_capacity(
     return max(8, (c + 7) // 8 * 8)
 
 
-def moe_ffn(
-    cfg: TransformerConfig,
-    lp: Params,  # router [D, E], w_gate/w_up [E, D, Fm], w_down [E, Fm, D]
-    h: jax.Array,  # [B, T, D]
-    dtype,
-) -> Tuple[jax.Array, jax.Array]:
-    """Returns (output [B, T, D], load-balance aux loss scalar fp32)."""
-    B, T, D = h.shape
-    E, k = cfg.num_experts, cfg.num_experts_per_tok
-    N = B * T
-    C = expert_capacity(N, E, k, cfg.moe_capacity_factor)
-    x = h.reshape(N, D)
-
+def _route(lp: Params, x: jax.Array, k: int):
+    """Shared top-k router: -> (probs [N, E] fp32, gate_vals [N, k]
+    renormalised, gate_idx [N, k])."""
     router_logits = jnp.einsum(
         "nd,de->ne", x.astype(jnp.float32), lp["router"].astype(jnp.float32)
     )
     probs = jax.nn.softmax(router_logits, axis=-1)  # [N, E] fp32
     gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [N, k]
     gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    return probs, gate_vals, gate_idx
+
+
+def _aux_loss(probs: jax.Array, gate_idx: jax.Array, E: int) -> jax.Array:
+    """Switch load-balancing loss: E * sum_i f_i * P_i where f_i is the
+    fraction of tokens whose FIRST choice is expert i and P_i the mean
+    router probability for i."""
+    first = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
+    f = jnp.mean(first, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return jnp.asarray(E, jnp.float32) * jnp.sum(f * p)
+
+
+def moe_ffn(
+    cfg: TransformerConfig,
+    lp: Params,  # router [D, E], w_gate/w_up [E, D, Fm], w_down [E, Fm, D]
+    h: jax.Array,  # [B, T, D]
+    dtype,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (output [B, T, D], load-balance aux loss scalar fp32).
+
+    cfg.moe_impl picks the dispatch: "capacity" (GShard dense dispatch,
+    tokens past the per-expert budget dropped) or "dropless" (exact HF
+    Mixtral/Qwen3-MoE semantics via sort + grouped GEMM)."""
+    if cfg.moe_impl == "dropless":
+        return _moe_ffn_dropless(cfg, lp, h, dtype)
+    return _moe_ffn_capacity(cfg, lp, h, dtype)
+
+
+def _moe_ffn_dropless(
+    cfg: TransformerConfig, lp: Params, h: jax.Array, dtype
+) -> Tuple[jax.Array, jax.Array]:
+    """Dropless token routing — the semantics real HF MoE checkpoints were
+    trained with (HF MixtralSparseMoeBlock / Qwen3MoeSparseMoeBlock apply
+    every top-k assignment with no capacity bound), so loaded checkpoints
+    produce batch-size-independent logits (ADVICE r3).
+
+    TPU shape: sort the N*k (token, expert) assignments by expert id, run
+    one grouped GEMM per projection with `lax.ragged_dot` (MegaBlocks-style
+    — the expert boundary is a group-sizes vector, shapes stay static), and
+    scatter-add weighted outputs back.  FLOPs equal capacity-mode at factor
+    1.0 with zero drops; no [N, E, C] dispatch tensors are materialised."""
+    B, T, D = h.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    N = B * T
+    x = h.reshape(N, D)
+    probs, gate_vals, gate_idx = _route(lp, x, k)
+
+    e_flat = gate_idx.reshape(-1)  # [N*k] expert id per assignment
+    order = jnp.argsort(e_flat)  # stable: preserves token order per expert
+    tok = order // k  # source token per sorted assignment
+    xs = jnp.take(x, tok, axis=0)  # [N*k, D]
+    group_sizes = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+
+    gate = jax.lax.ragged_dot(xs, lp["w_gate"].astype(dtype), group_sizes)
+    up = jax.lax.ragged_dot(xs, lp["w_up"].astype(dtype), group_sizes)
+    ys = jax.lax.ragged_dot(
+        jax.nn.silu(gate) * up, lp["w_down"].astype(dtype), group_sizes
+    )  # [N*k, D]
+
+    w_sorted = jnp.take(gate_vals.reshape(-1), order).astype(dtype)
+    out = jnp.zeros((N, D), dtype).at[tok].add(ys * w_sorted[:, None])
+    return out.reshape(B, T, D), _aux_loss(probs, gate_idx, E)
+
+
+def _moe_ffn_capacity(
+    cfg: TransformerConfig, lp: Params, h: jax.Array, dtype
+) -> Tuple[jax.Array, jax.Array]:
+    B, T, D = h.shape
+    E, k = cfg.num_experts, cfg.num_experts_per_tok
+    N = B * T
+    C = expert_capacity(N, E, k, cfg.moe_capacity_factor)
+    x = h.reshape(N, D)
+    probs, gate_vals, gate_idx = _route(lp, x, k)
 
     # position-in-expert assignment, choice-major priority (first choices
     # beat second choices for capacity, standard GShard ordering)
@@ -82,12 +149,4 @@ def moe_ffn(
         "ecf,efd->ecd", jax.nn.silu(gate) * up, lp["w_down"].astype(dtype)
     )  # [E, C, D]
     out = jnp.einsum("nec,ecd->nd", combine.astype(dtype), ye)
-
-    # Switch load-balancing loss: E * sum_i f_i * P_i where f_i is the
-    # fraction of tokens whose FIRST choice is expert i and P_i the mean
-    # router probability for i
-    first = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
-    f = jnp.mean(first, axis=0)
-    p = jnp.mean(probs, axis=0)
-    aux = jnp.asarray(E, jnp.float32) * jnp.sum(f * p)
-    return out.reshape(B, T, D), aux
+    return out.reshape(B, T, D), _aux_loss(probs, gate_idx, E)

@@ -159,11 +159,12 @@ def _vit_layer(
     cfg: VisionConfig,
     lp: Params,
     x: jax.Array,
-    img_ids: jax.Array,
+    mask: jax.Array,  # bool [N, N] attention partition (image or window)
     rope: Optional[Tuple[jax.Array, jax.Array]] = None,  # (cos, sin) [N, hd/2]
 ):
-    """One bidirectional block over [N, D] patches; attention only within
-    the same image (img_ids [N], -1 = padding)."""
+    """One bidirectional block over [N, D] patches; attention only where
+    `mask` allows (same image, or same window for Qwen2.5-VL windowed
+    blocks)."""
     N, D = x.shape
     H, hd = cfg.num_heads, cfg.head_dim
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
@@ -176,7 +177,6 @@ def _vit_layer(
         q = _apply_vision_rope(q, *rope)
         k = _apply_vision_rope(k, *rope)
     scores = jnp.einsum("nhd,mhd->hnm", q, k).astype(jnp.float32) / np.sqrt(hd)
-    mask = (img_ids[:, None] == img_ids[None, :]) & (img_ids[:, None] >= 0)
     scores = jnp.where(mask[None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("hnm,mhd->nhd", probs, v).reshape(N, D)
@@ -212,9 +212,17 @@ def vision_forward(
     `patch_pos_hw` (vision_rot_pos_ids) enables the 2D rotary embedding —
     without it the tower is permutation-blind to spatial layout within an
     image (legacy batches; spatial signal then comes only from merge
-    grouping + decoder mrope).  Blocks attend across each whole image
-    (Qwen2-VL full attention; 2.5-VL's windowed layers are approximated by
-    full attention — a superset receptive field)."""
+    grouping + decoder mrope).
+
+    When `cfg.window_size > 0` (Qwen2.5-VL), blocks NOT in
+    `cfg.fullatt_block_indexes` attend only within window_size-pixel tiles
+    of their image: window membership is derived on device from
+    `patch_pos_hw` (h//s, w//s with s the window side in patches), which
+    partitions patches identically to HF's get_window_index reordering for
+    still images (t=1).  For videos (t>1) the same (h, w) tile of
+    different frames shares a window — a superset of HF, which windows
+    per frame.  Without patch_pos_hw the tower falls back to full
+    attention per image."""
     dtype = pixel_values.dtype
     x = pixel_values @ params["patch_embed"].astype(dtype)
     rope = None
@@ -222,10 +230,34 @@ def vision_forward(
         angles = _vision_rope_angles(cfg, patch_pos_hw)
         rope = (jnp.cos(angles), jnp.sin(angles))
 
-    def body(x, lp):
-        return _vit_layer(cfg, lp, x, img_ids, rope=rope), None
+    img_mask = (img_ids[:, None] == img_ids[None, :]) & (img_ids[:, None] >= 0)
+    # HF computes the window grid on merge units: side (in patches) is
+    # (window // merge // patch) * merge so truncation matches exactly
+    s = (
+        cfg.window_size // cfg.spatial_merge_size // cfg.patch_size
+    ) * cfg.spatial_merge_size
+    if cfg.window_size > 0 and s > 0 and patch_pos_hw is not None:
+        wh, ww = patch_pos_hw[:, 0] // s, patch_pos_hw[:, 1] // s
+        win_mask = (
+            img_mask & (wh[:, None] == wh[None, :]) & (ww[:, None] == ww[None, :])
+        )
+        L = params["layers"]["input_norm"].shape[0]
+        is_full = jnp.asarray(
+            [l in cfg.fullatt_block_indexes for l in range(L)], bool
+        )
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        def body(x, scanned):
+            lp, full = scanned
+            mask = jnp.where(full, img_mask, win_mask)
+            return _vit_layer(cfg, lp, x, mask, rope=rope), None
+
+        x, _ = jax.lax.scan(body, x, (params["layers"], is_full))
+    else:
+
+        def body(x, lp):
+            return _vit_layer(cfg, lp, x, img_mask, rope=rope), None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["merger_norm"], cfg.rms_norm_eps)
     m2 = cfg.spatial_merge_size**2
     x = x.reshape(x.shape[0] // m2, m2 * cfg.hidden_size)

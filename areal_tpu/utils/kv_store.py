@@ -191,7 +191,10 @@ class HttpNameRecordRepository(NameRecordRepository):
 
         self.addr = addr
         self.ttl = ttl
-        self._session = requests.Session()
+        # requests.Session is NOT thread-safe: the keepalive thread and the
+        # caller thread must each get their own (ADVICE r3 — sharing one
+        # races on the connection pool under load)
+        self._local = threading.local()
         self._to_delete: List[str] = []
         self._leased: List[str] = []
         self._lock = threading.Lock()
@@ -209,10 +212,13 @@ class HttpNameRecordRepository(NameRecordRepository):
         escape as a connection error and silently kill a watcher thread."""
         import requests
 
+        session = getattr(self._local, "session", None)
+        if session is None:
+            session = self._local.session = requests.Session()
         last: Optional[BaseException] = None
         for attempt in range(retries):
             try:
-                return self._session.request(
+                return session.request(
                     method, self._url(path), timeout=30, **kw
                 )
             except requests.RequestException as e:

@@ -220,6 +220,51 @@ def test_checkpoint_watcher_picks_up_trainer_publishes(fleet, tmp_path):
         h.stop()
 
 
+def test_transfer_mode_version_sources_unwedge_gate(fleet):
+    """ADVICE r3 (medium): in a transfer-mode fleet (no disk checkpoints,
+    trainer pushes chunks straight to servers) the router's gate version
+    must still advance — via POST /set_version from the train loop, or the
+    backend /health version poll — or admission wedges at 409 forever."""
+    import time as _time
+
+    servers, addrs = fleet
+    cfg = RouterConfig(
+        train_batch_size=1,
+        max_head_offpolicyness=0,
+        version_poll_interval=0.05,  # no weights_path -> poller active
+    )
+    router = Router(cfg, addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # budget (0 + 0 + 1) * 1 = 1: second admission is staleness-bound
+        s, r = _post(raddr, "/allocate_request", {"qid": "a"})
+        assert s == 200
+        _post(raddr, "/finish_request", {"alloc_id": r["alloc_id"],
+                                         "accepted": True})
+        s, _ = _post(raddr, "/allocate_request", {"qid": "b"},
+                     expect_status=409)
+        assert s == 409
+
+        # source 1: the trainer's explicit /set_version (jax_train.py
+        # _notify_router after a transfer commit)
+        s, out = _post(raddr, "/set_version", {"version": 1})
+        assert s == 200 and out["version"] == 1
+        s, _ = _post(raddr, "/allocate_request", {"qid": "b"})
+        assert s == 200
+
+        # source 2: the backend health poll — a transfer commit bumps each
+        # server's served version even when nobody calls /set_version
+        for srv in servers:
+            srv.version = 5
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and router.version < 5:
+            _time.sleep(0.05)
+        assert router.version == 5
+    finally:
+        h.stop()
+
+
 def test_fleet_gate_two_clients_share_one_budget(fleet, monkeypatch):
     """VERDICT r2 #2: N clients against one fleet must share ONE staleness
     budget (reference is_staled, gserver_manager.py:334).  Two RemoteJaxEngine

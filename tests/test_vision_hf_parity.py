@@ -82,6 +82,83 @@ def test_vision_tower_matches_transformers(tmp_path):
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-5)
 
 
+def test_vision_tower_windowed_attention_matches_transformers(tmp_path):
+    """ADVICE r3: real Qwen2.5-VL checkpoints use WINDOWED attention in most
+    blocks (full attention only at fullatt_block_indexes).  An 8x8-patch
+    image with window_size=8px (=> 4x4-patch windows) spans 4 windows, so
+    this fails if the tower runs full attention everywhere."""
+    from transformers import Qwen2_5_VLConfig, Qwen2_5_VLForConditionalGeneration
+
+    cfg_hf = Qwen2_5_VLConfig(
+        vocab_size=128,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        tie_word_embeddings=False,
+        image_token_id=120,
+        video_token_id=121,
+        vision_start_token_id=118,
+        vision_end_token_id=119,
+        rope_scaling={"type": "mrope", "mrope_section": [1, 1, 2]},
+        vision_config=dict(
+            depth=2,
+            hidden_size=32,
+            intermediate_size=64,
+            num_heads=2,
+            in_channels=3,
+            patch_size=2,
+            temporal_patch_size=2,
+            spatial_merge_size=2,
+            out_hidden_size=32,
+            window_size=8,  # 8px / 2px patches / merge 2 -> 4-patch windows
+            fullatt_block_indexes=[0],  # block 1 is windowed
+            tokens_per_second=2,
+        ),
+    )
+    torch.manual_seed(1)
+    model = Qwen2_5_VLForConditionalGeneration(cfg_hf).eval().to(torch.float32)
+    d = tmp_path / "hf_win"
+    model.save_pretrained(str(d))
+
+    from areal_tpu.models.hf import load_hf_params
+    from areal_tpu.models.vision import vision_forward, vision_rot_pos_ids
+
+    params, cfg = load_hf_params(str(d), dtype="float32")
+    assert cfg.vision.window_size == 8
+    assert cfg.vision.fullatt_block_indexes == (0,)
+
+    rng = np.random.default_rng(2)
+    grid = np.array([[1, 8, 8]], np.int64)  # 64 patches, 4 windows
+    pv = rng.normal(size=(64, cfg.vision.patch_dim)).astype(np.float32)
+
+    with torch.no_grad():
+        ref = model.visual(
+            torch.from_numpy(pv), grid_thw=torch.from_numpy(grid)
+        ).numpy()
+
+    ours = np.asarray(vision_forward(
+        params["vision"],
+        cfg.vision,
+        pv,
+        np.zeros(64, np.int32),
+        patch_pos_hw=vision_rot_pos_ids(grid, cfg.vision.spatial_merge_size),
+    ))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-5)
+
+    # sanity: full attention everywhere would NOT match (window mask matters)
+    full = np.asarray(vision_forward(
+        params["vision"],
+        cfg.vision.replace(window_size=0),
+        pv,
+        np.zeros(64, np.int32),
+        patch_pos_hw=vision_rot_pos_ids(grid, cfg.vision.spatial_merge_size),
+    ))
+    assert np.abs(full - ref).max() > 1e-3
+
+
 def test_vision_checkpoint_roundtrip(tmp_path):
     """our params -> HF names (real Qwen2.5-VL layout) -> our params."""
     from areal_tpu.models.hf import load_hf_params, save_hf_checkpoint
