@@ -97,18 +97,21 @@ def lm_logprobs_entropy(
     chunk: int = 1024,
     with_entropy: bool = True,
     entropy_clamp: float = 0.0,
+    entropy_grad: bool = True,
+    impl: str = "fused",  # fused | chunked (token-chunked legacy scan)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(logprobs, entropy, argmax-correct) of `labels`, fp32 numerics.
 
-    With an `LMOutput`, the head matmul + log-softmax run in a rematerialised
-    `lax.scan` over token chunks: peak extra memory is one [chunk, V] fp32
-    block instead of the full [tokens, V] logits (forward AND backward — the
-    scan transpose recomputes each chunk's logits and accumulates the head's
-    cotangent across chunks).  This is the TPU-side equivalent of the
-    reference's vocab-parallel cross-entropy memory discipline
-    (realhf .../tensor_parallel/modules.py:1180 vocab_parallel_cross_entropy):
-    same goal — never hold full fp32 logits — achieved by chunking time
-    instead of sharding vocab.
+    With an `LMOutput` the default "fused" impl runs the vocab-chunked
+    online-softmax head with a hand-written VJP (ops/fused_xent.py): never
+    holds [tokens, V] fp32 logits, accumulates dx in a [tokens, D] carry,
+    writes each dW vocab slice once, and (with entropy_grad=False — the
+    GRPO stats-only case) skips the entropy backward term entirely.  This
+    is the TPU-side counterpart of the reference's vocab-parallel
+    cross-entropy memory discipline (realhf .../tensor_parallel/
+    modules.py:1180 vocab_parallel_cross_entropy).  "chunked" keeps the
+    legacy rematerialised token-chunk scan (also used for entropy_clamp,
+    which needs a per-token top-k over the full vocab row).
     """
     from areal_tpu.models.transformer import LMOutput
 
@@ -122,6 +125,19 @@ def lm_logprobs_entropy(
         return logp, ent, corr
 
     shape = labels.shape
+    if impl == "fused" and entropy_clamp == 0:
+        from areal_tpu.ops.fused_xent import fused_logprobs_entropy
+
+        D = out.hidden.shape[-1]
+        lp, ent, corr = fused_logprobs_entropy(
+            out.hidden.reshape(-1, D),
+            out.head,
+            labels.reshape(-1),
+            temperature=temperature,
+            with_entropy=with_entropy,
+            entropy_grad=entropy_grad,
+        )
+        return lp.reshape(shape), ent.reshape(shape), corr.reshape(shape)
     D = out.hidden.shape[-1]
     h = out.hidden.reshape(-1, D)
     lab = labels.reshape(-1)
@@ -259,7 +275,11 @@ def grpo_loss_fn(
     labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     loss_mask = batch["loss_mask"].astype(jnp.float32)
     logprobs, entropy, _ = lm_logprobs_entropy(
-        model_out, labels, temperature=temperature
+        model_out, labels, temperature=temperature,
+        # entropy is a logged stat unless an entropy bonus actually trains
+        # on it — skipping its backward term saves an elementwise pass over
+        # every recomputed logits block
+        entropy_grad=bool(entropy_coef),
     )
     old_logp = batch["logprobs"]
     prox = batch.get("prox_logp") if use_decoupled_loss else None
@@ -324,7 +344,9 @@ def sft_loss_fn(
     (reference: areal/engine/sft/lm_engine.py)."""
     labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     mask = batch["loss_mask"].astype(jnp.float32)
-    logprobs, _, correct = lm_logprobs_entropy(model_out, labels)
+    logprobs, _, correct = lm_logprobs_entropy(
+        model_out, labels, entropy_grad=False
+    )
     loss = -jnp.sum(logprobs * mask)
     aux = getattr(model_out, "aux_loss", None)
     if aux is not None:
