@@ -239,3 +239,117 @@ def test_7b_shape_tp_serving_compiles():
                      temperature=0.0)
     engine.generate_blocking([req])
     assert len(req.output_tokens) == 4
+
+
+# ---------------------------------------------------------------------------
+# KV prefix reuse (VERDICT r3 #3) + near-cache-end decoupling (weak #3)
+# ---------------------------------------------------------------------------
+
+
+def _fresh_engine(cfg, params, **kw):
+    from areal_tpu.gen.engine import GenEngine
+
+    base = dict(n_slots=4, max_seq_len=128, prompt_bucket=16,
+                kv_dtype="float32", reuse_min_tokens=4)
+    base.update(kw)
+    return GenEngine(cfg, params=params, **base)
+
+
+def test_multi_turn_suffix_prefill_matches_fresh(setup):
+    """Turn 2 extends turn 1's transcript: the engine must reuse the
+    retained cache (suffix-only prefill) and emit EXACTLY the tokens a
+    fresh engine produces."""
+    cfg, params, _ = setup
+    rng = np.random.default_rng(7)
+    turn1 = rng.integers(0, 97, 24).tolist()
+
+    eng = _fresh_engine(cfg, params)
+    r1 = GenRequest(rid="t", input_ids=turn1, max_new_tokens=6, temperature=0.0)
+    eng.generate_blocking([r1])
+    transcript = turn1 + r1.output_tokens + rng.integers(0, 97, 5).tolist()
+
+    # same turn-2 prompt on a reuse engine and on a cold engine
+    r2 = GenRequest(rid="t", input_ids=transcript, max_new_tokens=6,
+                    temperature=0.0)
+    eng.generate_blocking([r2])
+    cold = _fresh_engine(cfg, params, kv_reuse=False)
+    r2c = GenRequest(rid="t", input_ids=list(transcript), max_new_tokens=6,
+                     temperature=0.0)
+    cold.generate_blocking([r2c])
+    assert r2.output_tokens == r2c.output_tokens
+    assert eng.stats["suffix_calls"] == 1
+    assert eng.stats["reused_tokens"] >= 24  # the shared prefix was NOT recomputed
+    # turn-2 prefill cost is proportional to the NEW tokens, not the context
+    assert eng.stats["suffix_tokens"] <= len(transcript) - eng.stats["reused_tokens"] + 1
+
+
+def test_interruption_resume_reuses_prefix(setup):
+    """abort (weight update) -> client resubmits prompt + accumulated tokens:
+    the resume must be a suffix prefill over the retained cache."""
+    cfg, params, _ = setup
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 97, 16).tolist()
+    eng = _fresh_engine(cfg, params)
+    r1 = GenRequest(rid="i", input_ids=prompt, max_new_tokens=8, temperature=0.0)
+    eng.submit(r1)
+    while len(r1.output_tokens) < 3:  # partial decode, then interrupt
+        eng.step(chunk=2)
+    eng.abort_all("abort")
+    got = len(r1.output_tokens)
+    assert got > 0 and r1.stop_reason == "abort"
+
+    resumed = GenRequest(rid="i", input_ids=prompt + r1.output_tokens,
+                         max_new_tokens=8 - got, temperature=0.0)
+    eng.generate_blocking([resumed])
+    assert eng.stats["suffix_calls"] >= 1
+    assert eng.stats["reused_tokens"] >= len(prompt) - 1
+    # the resumed continuation equals the uninterrupted greedy rollout
+    ref = _greedy_reference(cfg, params, prompt, 8)
+    assert r1.output_tokens + resumed.output_tokens == ref
+
+
+def test_near_cache_end_slot_does_not_clamp_grid(setup):
+    """One slot close to max_seq_len must not force the whole grid into
+    1-token decode round-trips (VERDICT r3 weak #3)."""
+    cfg, params, _ = setup
+    eng = _fresh_engine(cfg, params, max_seq_len=64, kv_reuse=False)
+    rng = np.random.default_rng(9)
+    near = GenRequest(rid="near", input_ids=rng.integers(0, 97, 58).tolist(),
+                      max_new_tokens=32, temperature=0.0)
+    far = GenRequest(rid="far", input_ids=rng.integers(0, 97, 4).tolist(),
+                     max_new_tokens=32, temperature=0.0)
+    solo_far = _greedy_reference(cfg, params, far.input_ids, 32)
+    eng.generate_blocking([near, far])
+    # near hits the cache wall quickly...
+    assert near.stop_reason == "length" and len(near.output_tokens) <= 6
+    # ...while far still decodes its full budget CORRECTLY
+    assert far.output_tokens == solo_far
+    # and the grid kept full-chunk steps: 32 tokens / chunk 8 => ~4-6 calls,
+    # not ~32 one-token calls
+    assert eng.stats["decode_calls"] <= 8, eng.stats
+
+
+def test_reuse_disabled_under_flag(setup):
+    cfg, params, _ = setup
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, 97, 20).tolist()
+    eng = _fresh_engine(cfg, params, kv_reuse=False)
+    r1 = GenRequest(rid="x", input_ids=prompt, max_new_tokens=4, temperature=0.0)
+    eng.generate_blocking([r1])
+    r2 = GenRequest(rid="x", input_ids=prompt + r1.output_tokens,
+                    max_new_tokens=4, temperature=0.0)
+    eng.generate_blocking([r2])
+    assert eng.stats["suffix_calls"] == 0
+
+
+def test_reload_flush_policy(setup):
+    """retain_kv_on_reload=False drops retained prefixes at load_weights."""
+    cfg, params, _ = setup
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, 97, 20).tolist()
+    eng = _fresh_engine(cfg, params, retain_kv_on_reload=False)
+    r1 = GenRequest(rid="f", input_ids=prompt, max_new_tokens=4, temperature=0.0)
+    eng.generate_blocking([r1])
+    assert eng.retained_len.max() > 0
+    eng.load_weights(params=params, version=1)
+    assert eng.retained_len.max() == 0
