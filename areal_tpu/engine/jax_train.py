@@ -825,16 +825,31 @@ class JaxTrainEngine(TrainEngine):
             )
         except Exception:  # noqa: BLE001 — no router in this deployment
             return
-        try:
-            import requests
+        version = self._version
 
-            requests.post(
-                f"http://{addr}/set_version",
-                json={"version": self._version},
-                timeout=10,
+        def _post():
+            try:
+                import requests
+
+                requests.post(
+                    f"http://{addr}/set_version",
+                    json={"version": version},
+                    timeout=5,
+                )
+            except Exception as e:  # noqa: BLE001 — poller covers the miss
+                logger.warning(
+                    f"router /set_version failed (poll covers it): {e}"
+                )
+
+        # fire-and-forget on the transfer thread: a stale router address
+        # must not stall the publish path on a connect timeout
+        if self._transfer_executor is None:
+            import concurrent.futures
+
+            self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="weight-transfer"
             )
-        except Exception as e:  # noqa: BLE001 — poller covers the miss
-            logger.warning(f"router /set_version failed (poll covers it): {e}")
+        self._transfer_executor.submit(_post)
 
     def save(self, meta: SaveLoadMeta) -> None:
         """Model weights as an HF safetensors dir (interop with inference

@@ -1,0 +1,121 @@
+"""Benchmark registry for the offline eval harness.
+
+Counterpart of the reference's benchmark suite
+(/root/reference/evaluation/: data/{aime24,aime25,amc23,math_500,
+gpqa_diamond}/test.jsonl + per-model prompt templates in utils.py).  This
+repo resolves benchmark data from a data root rather than vendoring the
+problem sets (keep the repo code-only; `scripts/fetch_eval_data.py`
+populates the root from public dataset hubs, or point AREAL_EVAL_DATA at
+an existing checkout of the reference's `evaluation/data/`).
+
+Prompting goes through the checkpoint's own chat template
+(`tokenizer.apply_chat_template`) with the standard boxed-answer
+instruction — the template-per-model tables the reference maintains
+(utils.py PROMPT_TEMPLATES) exist because it renders raw strings per
+architecture; rendering through the tokenizer makes one instruction work
+for every model family this repo serves.
+"""
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+BOXED_INSTRUCTION = (
+    "Please reason step by step, and put your final answer within \\boxed{}."
+)
+CHOICE_INSTRUCTION = (
+    "Please reason step by step, and put the letter of your chosen option "
+    "within \\boxed{} at the end."
+)
+
+
+@dataclass(frozen=True)
+class BenchmarkSpec:
+    name: str
+    file: str  # relative to the data root
+    question_field: str
+    answer_field: str
+    instruction: str = BOXED_INSTRUCTION
+    # multiple-choice benchmarks render labeled options under the question
+    options_field: Optional[str] = None
+
+
+BENCHMARKS: Dict[str, BenchmarkSpec] = {
+    s.name: s
+    for s in [
+        BenchmarkSpec("aime24", "aime24/test.jsonl", "problem", "answer"),
+        BenchmarkSpec("aime25", "aime25/test.jsonl", "problem", "answer"),
+        BenchmarkSpec("amc23", "amc23/test.jsonl", "problem", "answer"),
+        BenchmarkSpec("math_500", "math_500/test.jsonl", "problem", "answer"),
+        BenchmarkSpec(
+            "gpqa_diamond",
+            "gpqa_diamond/test.jsonl",
+            "question",
+            "answer",
+            instruction=CHOICE_INSTRUCTION,
+            options_field="labeled_options",
+        ),
+    ]
+}
+
+
+def resolve_data_root(data_root: Optional[str] = None) -> str:
+    """--data-root arg > AREAL_EVAL_DATA env > <repo>/evaluation/data."""
+    if data_root:
+        return data_root
+    env = os.environ.get("AREAL_EVAL_DATA")
+    if env:
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    return os.path.join(here, "evaluation", "data")
+
+
+def load_benchmark(
+    name: str, data_root: Optional[str] = None, limit: Optional[int] = None
+) -> List[Dict]:
+    """-> [{"messages": [...], "answer": str}, ...] ready for the engine."""
+    spec = BENCHMARKS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"unknown benchmark {name!r}; known: {sorted(BENCHMARKS)}"
+        )
+    path = os.path.join(resolve_data_root(data_root), spec.file)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"benchmark data not found at {path}; run "
+            f"scripts/fetch_eval_data.py or set AREAL_EVAL_DATA"
+        )
+    problems = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            q = row[spec.question_field]
+            if spec.options_field and spec.options_field in row:
+                opts = row[spec.options_field]
+                if isinstance(opts, str):
+                    if opts.startswith("["):
+                        # python-repr list (the reference's gpqa rows);
+                        # literal_eval survives apostrophes inside options
+                        import ast
+
+                        opts = ast.literal_eval(opts)
+                    else:
+                        opts = [opts]
+                q = q + "\n" + "\n".join(str(o) for o in opts)
+            problems.append(
+                {
+                    "messages": [
+                        {"role": "user", "content": f"{q}\n{spec.instruction}"}
+                    ],
+                    "answer": str(row[spec.answer_field]),
+                }
+            )
+            if limit and len(problems) >= limit:
+                break
+    if not problems:
+        raise ValueError(f"no problems in {path}")
+    return problems

@@ -191,7 +191,12 @@ def code_reward_fn(
     """Reward-API-compatible entry (same signature family as
     reward/math_parser.py gsm8k_reward_fn): 1.0 iff every test case of the
     sample's problem passes.  The problem spec rides in the dataset row
-    under 'problem' (dict or JSON string)."""
+    under 'problem' (dict or JSON string).
+
+    With AREAL_CODE_VERIFIER_ADDR set, verification is delegated to the
+    remote service (reward/code_verifier_service.py — the reference's FaaS
+    deployment shape, functioncall/) so untrusted code never runs on the
+    rollout host; the local rlimit sandbox remains the fallback."""
     problem = data.get("problem")
     if problem is None:
         raise ValueError("code_reward_fn needs a 'problem' field in data")
@@ -199,10 +204,22 @@ def code_reward_fn(
         import json
 
         problem = json.loads(problem)
+    timeout = float(data.get("case_timeout", DEFAULT_TIMEOUT))
+    max_cases = data.get("max_cases")
+    addr = os.environ.get("AREAL_CODE_VERIFIER_ADDR")
+    if addr:
+        from areal_tpu.reward.code_verifier_service import remote_verify_reward
+
+        try:
+            return remote_verify_reward(
+                addr, completions, problem, timeout=timeout, max_cases=max_cases
+            )
+        except Exception as e:  # noqa: BLE001 — degrade to local sandbox
+            logger.warning(
+                f"code verifier service {addr} unreachable ({e}); "
+                "falling back to the local sandbox"
+            )
     results = verify_code(
-        completions,
-        problem,
-        timeout=float(data.get("case_timeout", DEFAULT_TIMEOUT)),
-        max_cases=data.get("max_cases"),
+        completions, problem, timeout=timeout, max_cases=max_cases
     )
     return 1.0 if results and all(r.passed for r in results) else 0.0

@@ -1,0 +1,73 @@
+"""Populate evaluation/data/ with the public math benchmark sets.
+
+The offline eval harness (areal_tpu/evaluation/run_eval.py --benchmark)
+reads `<data-root>/<bench>/test.jsonl` rows shaped like the reference's
+evaluation/data/ files ({"problem": ..., "answer": ...}).  This script
+builds that layout from the public HF dataset hub (needs egress; in
+air-gapped environments point AREAL_EVAL_DATA at an existing checkout of
+the reference's evaluation/data/ instead).
+
+    python scripts/fetch_eval_data.py [--root evaluation/data] \
+        [--benchmarks aime24,aime25,amc23,math_500]
+"""
+
+import argparse
+import json
+import os
+
+# benchmark -> (hub dataset id, split, question key, answer key)
+SOURCES = {
+    "aime24": ("HuggingFaceH4/aime_2024", "train", "problem", "answer"),
+    "aime25": ("math-ai/aime25", "test", "problem", "answer"),
+    "amc23": ("math-ai/amc23", "test", "question", "answer"),
+    "math_500": ("HuggingFaceH4/MATH-500", "test", "problem", "answer"),
+}
+
+
+def fetch(root: str, benchmarks):
+    from datasets import load_dataset  # requires egress
+
+    for name in benchmarks:
+        if name == "gpqa_diamond":
+            print(
+                "gpqa_diamond: the GPQA dataset is gated (Idavidrein/gpqa "
+                "license click-through) and cannot be fetched here; accept "
+                "the license on the HF hub and export rows as "
+                f"{os.path.join(root, 'gpqa_diamond', 'test.jsonl')} with "
+                "fields question/labeled_options/answer, or point "
+                "AREAL_EVAL_DATA at an existing benchmark-data checkout."
+            )
+            continue
+        if name not in SOURCES:
+            print(f"skipping unknown benchmark {name!r}")
+            continue
+        repo, split, qk, ak = SOURCES[name]
+        print(f"fetching {name} from {repo}:{split} ...")
+        ds = load_dataset(repo, split=split)
+        out_dir = os.path.join(root, name)
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "test.jsonl")
+        with open(path, "w") as f:
+            for i, row in enumerate(ds):
+                f.write(json.dumps({
+                    "id": i,
+                    "problem": row[qk],
+                    "answer": str(row[ak]),
+                }) + "\n")
+        print(f"  wrote {len(ds)} problems to {path}")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    default_root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "evaluation", "data",
+    )
+    p.add_argument("--root", default=default_root)
+    p.add_argument("--benchmarks", default=",".join(SOURCES))
+    args = p.parse_args()
+    fetch(args.root, [b.strip() for b in args.benchmarks.split(",")])
+
+
+if __name__ == "__main__":
+    main()

@@ -102,3 +102,120 @@ def test_reward_fn_surface():
 
     with pytest.raises(ValueError):
         code_reward_fn("p", "x", [], [])
+
+
+# ---------------------------------------------------------------------------
+# Service mode (VERDICT r3 missing #5 — the reference's functioncall/ FaaS)
+# ---------------------------------------------------------------------------
+
+
+class _ServiceHarness:
+    """Run the verifier service on a background loop (fake-server pattern)."""
+
+    def __init__(self):
+        import asyncio
+        import threading
+
+        from aiohttp import web
+
+        from areal_tpu.reward.code_verifier_service import CodeVerifierService
+
+        self.service = CodeVerifierService(max_workers=2)
+        self.port = None
+        started = threading.Event()
+
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                runner = web.AppRunner(self.service.app())
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        import threading as _t
+
+        self._thread = _t.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert started.wait(10)
+        self.addr = f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        import asyncio
+
+        async def _cleanup():
+            await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self._loop).result(5)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+def test_service_mode_verifies_remotely():
+    h = _ServiceHarness()
+    try:
+        import requests
+
+        r = requests.post(
+            f"http://{h.addr}/verify",
+            json={
+                "generation": "```python\nprint(int(input())**2)\n```",
+                "problem": {"inputs": ["3\n"], "outputs": ["9\n"]},
+            },
+            timeout=30,
+        )
+        assert r.status_code == 200
+        body = r.json()
+        assert body["reward"] == 1.0 and body["results"][0]["passed"]
+
+        r = requests.post(
+            f"http://{h.addr}/verify",
+            json={"generation": "print(1)",
+                  "problem": {"inputs": ["\n"], "outputs": ["2\n"]}},
+            timeout=30,
+        )
+        assert r.json()["reward"] == 0.0
+
+        # malformed problems are a 400, not a worker crash
+        r = requests.post(
+            f"http://{h.addr}/verify",
+            json={"generation": "x", "problem": {"bogus": 1}},
+            timeout=30,
+        )
+        assert r.status_code == 400
+        assert requests.get(f"http://{h.addr}/health", timeout=10).json()[
+            "served"
+        ] == 2
+    finally:
+        h.stop()
+
+
+def test_reward_fn_targets_service_env(monkeypatch):
+    """code_reward_fn uses AREAL_CODE_VERIFIER_ADDR when set, and falls back
+    to the local sandbox when the service is unreachable."""
+    h = _ServiceHarness()
+    try:
+        monkeypatch.setenv("AREAL_CODE_VERIFIER_ADDR", h.addr)
+        problem = {"inputs": ["2\n"], "outputs": ["4\n"]}
+        good = code_reward_fn(
+            "p", "```python\nprint(int(input())**2)\n```", [], [],
+            problem=problem,
+        )
+        assert good == 1.0
+        assert h.service.n_served == 1  # it really went through the service
+    finally:
+        h.stop()
+
+    # dead address: local fallback still produces the right reward
+    monkeypatch.setenv("AREAL_CODE_VERIFIER_ADDR", "127.0.0.1:1")
+    assert code_reward_fn(
+        "p", "```python\nprint(int(input())**2)\n```", [], [],
+        problem={"inputs": ["2\n"], "outputs": ["4\n"]},
+    ) == 1.0
