@@ -102,6 +102,19 @@ class ColocatedEngine:
         self.engine.restage(params=host_params, version=version)
         self.start_serving()
 
+    def update_weights_in_memory(self, host_params, version: int) -> float:
+        """Publish WITHOUT releasing serving HBM (both sides resident —
+        the async colocated regime): pause the stepper, swap weights via
+        the engine's abort-and-reload (in-flight requests resume through
+        agenerate's interruption loop), restart.  Returns the achieved
+        generation pause window in seconds."""
+        self.stop_serving()
+        t0 = time.perf_counter()
+        self.engine.load_weights(params=host_params, version=version)
+        pause = time.perf_counter() - t0
+        self.start_serving()
+        return pause
+
     def resume_serving(self) -> None:
         """Re-arm with the SAME weights (cache-only restage)."""
         self.engine.restage()

@@ -711,19 +711,22 @@ class JaxTrainEngine(TrainEngine):
         self._notify_router(meta)
         self.last_weight_update_seconds = time.perf_counter() - t0
 
-    def _run_on_transfer_thread(self, coro) -> None:
-        """Run an asyncio coroutine on the dedicated transfer thread (the
-        caller thread may own its own event loop) and block on it —
-        weight publication is a synchronous control-plane action."""
-        import asyncio
-
+    def _ensure_transfer_executor(self):
         if self._transfer_executor is None:
             import concurrent.futures
 
             self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="weight-transfer"
             )
-        self._transfer_executor.submit(asyncio.run, coro).result()
+        return self._transfer_executor
+
+    def _run_on_transfer_thread(self, coro) -> None:
+        """Run an asyncio coroutine on the dedicated transfer thread (the
+        caller thread may own its own event loop) and block on it —
+        weight publication is a synchronous control-plane action."""
+        import asyncio
+
+        self._ensure_transfer_executor().submit(asyncio.run, coro).result()
 
     def _push_transfer_chunks(self, meta: WeightUpdateMeta) -> None:
         """Stream every HF-named array, sliced into <= chunk_mb pieces, as
@@ -755,10 +758,15 @@ class JaxTrainEngine(TrainEngine):
         ]
         del host
 
+        version = self._version
+
         async def push(addr: str):
             import aiohttp
 
-            from areal_tpu.utils.http import get_default_connector
+            from areal_tpu.utils.http import (
+                arequest_with_retry,
+                get_default_connector,
+            )
 
             async with aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=600.0, sock_connect=30.0),
@@ -780,6 +788,17 @@ class JaxTrainEngine(TrainEngine):
                             timeout=300.0,
                             session=session,
                         )
+                # device-stage the assembled tree while generation keeps
+                # running: the later commit becomes an O(abort) pointer
+                # swap (best-effort — a server without standby HBM falls
+                # back to commit-time placement)
+                await arequest_with_retry(
+                    addr=addr,
+                    endpoint="/update_weights_chunk",
+                    payload={"prepare": True, "version": version},
+                    method="POST",
+                    timeout=600.0,
+                )
 
         async def run():
             await asyncio.gather(*[push(a) for a in addrs])
@@ -843,13 +862,7 @@ class JaxTrainEngine(TrainEngine):
 
         # fire-and-forget on the transfer thread: a stale router address
         # must not stall the publish path on a connect timeout
-        if self._transfer_executor is None:
-            import concurrent.futures
-
-            self._transfer_executor = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="weight-transfer"
-            )
-        self._transfer_executor.submit(_post)
+        self._ensure_transfer_executor().submit(_post)
 
     def save(self, meta: SaveLoadMeta) -> None:
         """Model weights as an HF safetensors dir (interop with inference

@@ -98,7 +98,7 @@ def lm_logprobs_entropy(
     with_entropy: bool = True,
     entropy_clamp: float = 0.0,
     entropy_grad: bool = True,
-    impl: str = "fused",  # fused | chunked (token-chunked legacy scan)
+    impl: Optional[str] = None,  # fused | chunked; None -> env or "fused"
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(logprobs, entropy, argmax-correct) of `labels`, fp32 numerics.
 
@@ -125,7 +125,14 @@ def lm_logprobs_entropy(
         return logp, ent, corr
 
     shape = labels.shape
+    if impl is None:
+        # AREAL_LM_HEAD_IMPL=chunked is the A/B + fallback lever
+        import os
+
+        impl = os.environ.get("AREAL_LM_HEAD_IMPL", "fused")
     if impl == "fused" and entropy_clamp == 0:
+        import os as _os
+
         from areal_tpu.ops.fused_xent import fused_logprobs_entropy
 
         D = out.hidden.shape[-1]
@@ -134,6 +141,7 @@ def lm_logprobs_entropy(
             out.head,
             labels.reshape(-1),
             temperature=temperature,
+            vocab_chunk=int(_os.environ.get("AREAL_LM_HEAD_CHUNK", 8192)),
             with_entropy=with_entropy,
             entropy_grad=entropy_grad,
         )

@@ -36,11 +36,15 @@ import numpy as np
 
 
 def _vocab_chunk(v: int, target: int) -> int:
-    """Largest divisor of v that is <= target (static shapes, no padding)."""
-    c = min(v, target)
-    while v % c:
-        c -= 1
-    return c
+    """MXU-friendly chunk width: a multiple of 128 (the systolic array's
+    lane width — an exact-divisor rule would hand Qwen's 151936 = 2^7*1187
+    vocab a 4748-wide chunk that tiles terribly), capped at the padded
+    vocab size.  The final partial chunk is handled by masking."""
+    return min(_round_up(v, 128), _round_up(target, 128))
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
@@ -52,13 +56,16 @@ def _fused_xent(inv_t, cv, with_entropy, entropy_grad, h, head, labels):
 def _fused_xent_fwd(inv_t, cv, with_entropy, entropy_grad, h, head, labels):
     N, D = h.shape
     V = head.shape[1]
-    nv = V // cv
+    nv = -(-V // cv)
     neg = jnp.float32(-1e30)
+    wp = _pad_head(head, nv * cv)
 
     def one_chunk(carry, i):
         m, s, mu_un, picked, amax_v, amax_i = carry
-        wc = jax.lax.dynamic_slice_in_dim(head, i * cv, cv, axis=1)
+        wc = jax.lax.dynamic_slice_in_dim(wp, i * cv, cv, axis=1)
         logits = (h @ wc).astype(jnp.float32) * inv_t
+        # mask the padded tail of the last chunk out of the softmax
+        logits = jnp.where(i * cv + jnp.arange(cv) < V, logits, neg)
         cm = jnp.max(logits, axis=-1)
         m_new = jnp.maximum(m, cm)
         alpha = jnp.exp(m - m_new)
@@ -102,18 +109,30 @@ def _fused_xent_fwd(inv_t, cv, with_entropy, entropy_grad, h, head, labels):
     return (logp, ent, corr), (h, head, labels, logz, mu)
 
 
+def _pad_head(head, vp: int):
+    V = head.shape[1]
+    if vp == V:
+        return head
+    return jnp.pad(head, ((0, 0), (0, vp - V)))
+
+
 def _fused_xent_bwd(inv_t, cv, with_entropy, entropy_grad, res, g):
     h, head, labels, logz, mu = res
     g_lp, g_ent, _ = g  # corr is gradient-free by construction
     N, D = h.shape
     V = head.shape[1]
-    nv = V // cv
+    nv = -(-V // cv)
+    wp = _pad_head(head, nv * cv)
     g_lp = g_lp.astype(jnp.float32)
     g_ent = g_ent.astype(jnp.float32)
 
     def one(dx, i):
-        wc = jax.lax.dynamic_slice_in_dim(head, i * cv, cv, axis=1)
+        wc = jax.lax.dynamic_slice_in_dim(wp, i * cv, cv, axis=1)
         logits = (h @ wc).astype(jnp.float32) * inv_t
+        # padded-tail logits produce p=0 via the same mask the fwd used
+        logits = jnp.where(
+            i * cv + jnp.arange(cv) < V, logits, jnp.float32(-1e30)
+        )
         p = jnp.exp(logits - logz[:, None])  # [N, cv]
         rel = labels - i * cv
         onehot = jnp.arange(cv)[None, :] == rel[:, None]
@@ -131,8 +150,10 @@ def _fused_xent_bwd(inv_t, cv, with_entropy, entropy_grad, res, g):
         return dx, dwc
 
     dx, dws = jax.lax.scan(one, jnp.zeros((N, D), jnp.float32), jnp.arange(nv))
-    # dws [nv, D, cv] -> [D, V]; each slice was written exactly once
-    dhead = jnp.swapaxes(dws, 0, 1).reshape(D, V).astype(head.dtype)
+    # dws [nv, D, cv] -> [D, Vp] -> [D, V]; each slice was written once
+    dhead = (
+        jnp.swapaxes(dws, 0, 1).reshape(D, nv * cv)[:, :V].astype(head.dtype)
+    )
     return (
         dx.astype(h.dtype),
         dhead,

@@ -214,10 +214,19 @@ def code_reward_fn(
             return remote_verify_reward(
                 addr, completions, problem, timeout=timeout, max_cases=max_cases
             )
-        except Exception as e:  # noqa: BLE001 — degrade to local sandbox
+        except Exception as e:  # noqa: BLE001
+            if os.environ.get("AREAL_CODE_VERIFIER_STRICT"):
+                # isolation deployments: NEVER run untrusted code on this
+                # host — a verifier outage fails the reward closed (0.0)
+                logger.error(
+                    f"code verifier service {addr} unreachable ({e}); "
+                    "strict mode returns reward 0 (no local execution)"
+                )
+                return 0.0
             logger.warning(
                 f"code verifier service {addr} unreachable ({e}); "
-                "falling back to the local sandbox"
+                "falling back to the LOCAL rlimit sandbox — set "
+                "AREAL_CODE_VERIFIER_STRICT=1 to fail closed instead"
             )
     results = verify_code(
         completions, problem, timeout=timeout, max_cases=max_cases

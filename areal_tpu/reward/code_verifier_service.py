@@ -92,6 +92,11 @@ class CodeVerifierService:
         return app
 
 
+import threading as _threading
+
+_session_local = _threading.local()
+
+
 def remote_verify_reward(
     addr: str,
     generation: str,
@@ -101,10 +106,15 @@ def remote_verify_reward(
     request_timeout: float = 120.0,
 ) -> float:
     """Client half: POST the submission to a verifier service.  Raises on
-    transport errors so the caller can fall back to the local sandbox."""
+    transport errors so the caller can fall back to the local sandbox (or
+    fail closed under AREAL_CODE_VERIFIER_STRICT).  Reward calls are the
+    hot path, so connections keep alive via a thread-local session."""
     import requests
 
-    r = requests.post(
+    session = getattr(_session_local, "session", None)
+    if session is None:
+        session = _session_local.session = requests.Session()
+    r = session.post(
         f"http://{addr}/verify",
         json={
             "generation": generation,

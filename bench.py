@@ -197,10 +197,16 @@ def main():
     ]
     result = None
     last_err = None
+    attempts = []  # self-describing bench (VERDICT r3 #10): which ladder
+    # rung produced the headline, and what failed on the way there
     for model_cfg, name, n_rows, row_len, n_mbs, policy in ladder:
+        rung = f"{name} x{n_rows}x{row_len} remat={policy}"
         try:
             result = _run(model_cfg, name, n_rows, row_len, n_mbs,
                           remat_policy=policy)
+            attempts.append({"rung": rung, "ok": True})
+            result["remat_policy"] = policy
+            result["n_rows"] = n_rows
             break
         except Exception as e:  # noqa: BLE001 — fall through the ladder on OOM
             last_err = e
@@ -209,12 +215,15 @@ def main():
             # crash; anything else is a real failure and must surface
             if "RESOURCE_EXHAUSTED" not in msg and "tpu_compile_helper" not in msg:
                 raise
+            attempts.append({"rung": rung, "ok": False, "error": msg[:120]})
             print(
                 f"bench: {name} x{n_rows} rows failed, trying smaller",
                 file=sys.stderr,
             )
     if result is None:
         raise last_err
+    result["attempts"] = attempts
+    result["lm_head_impl"] = os.environ.get("AREAL_LM_HEAD_IMPL", "fused")
 
     # ctx-scaling variant: one 16k-token sequence per row — evidence the
     # splash path holds at long context (no O(T^2) mask materialisation)

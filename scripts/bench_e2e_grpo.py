@@ -1,0 +1,263 @@
+"""End-to-end GRPO benchmark: async vs sync, trajectories/sec/chip.
+
+VERDICT r3 next-step #1 (second half) — THE system's primary metric
+(BASELINE.json: "Async GRPO trajectories/sec/chip").  The REAL loop runs
+on the chip: generation engine + rollout workflows + reward pool + PPO
+trainer + per-step weight publish, in two modes over the same workload:
+
+- **sync**: rollout_batch (generate-all, then train, then publish) — the
+  classic alternating loop;
+- **async**: WorkflowExecutor.prepare_batch keeps the rollout pipeline
+  saturated under the staleness gate (max_head_offpolicyness) while the
+  trainer consumes; weight publishes interrupt generation mid-flight and
+  clients resume with accumulated tokens (the interruptible-generation
+  machinery, blog/AReaL_v0_3.md:203-207).
+
+Single-chip regime: trainer and serving engine share the chip in one
+process (0.6B model — both fit), weights hand over in memory.  The async
+win measured here comes from pipeline overlap (host-side scheduling,
+reward computation, batch assembly, straggler absorption), not from
+disaggregated hardware — the multi-host deployment adds that on top.
+
+Prints ONE JSON line:
+  {"sync": {...}, "async": {...},
+   "async_over_sync_trajs_per_sec": R, "pause_window_s": {...}}
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _reward_any_even(prompt, completions, prompt_ids, completion_ids, **kw):
+    """Module-level so the reward process pool can pickle it."""
+    return float(any(t % 2 == 0 for t in completion_ids))
+
+
+def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
+                group_size: int):
+    import jax
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.colocated import ColocatedEngine
+    from areal_tpu.engine.ppo import JaxPPOActor
+    from areal_tpu.models.model_config import qwen2_0p6b_ctx, tiny_config
+
+    if model_scale == "0p6b":
+        cfg = qwen2_0p6b_ctx()
+    else:  # tiny smoke mode for CPU validation
+        cfg = tiny_config(vocab_size=512, qkv_bias=True,
+                          hf_architecture="Qwen2ForCausalLM")
+    cfg = cfg.replace(eos_token_id=None)
+
+    actor = JaxPPOActor(
+        PPOActorConfig(
+            experiment_name="e2e-bench", trial_name="b",
+            init_from_scratch=True,
+            dtype="bfloat16" if model_scale == "0p6b" else "float32",
+            param_dtype="bfloat16" if model_scale == "0p6b" else "float32",
+            gradient_checkpointing=True,
+            mesh=MeshConfig(),
+            mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=1e-6, warmup_steps_proportion=0.0),
+            pack_length_quantum=256,
+            max_pack_length=max_seq_len,
+            group_size=group_size,
+            ppo_n_minibatches=1,
+            use_decoupled_loss=True,
+            recompute_logprob=True,
+            async_stats=True,
+            adv_norm=NormConfig(mean_level="group", std_level="group",
+                                group_size=group_size),
+        ),
+        model_config=cfg.replace(
+            dtype="bfloat16" if model_scale == "0p6b" else "float32",
+            param_dtype="bfloat16" if model_scale == "0p6b" else "float32",
+        ),
+    )
+    actor.initialize(ft_spec=FinetuneSpec(1, 4096, 8))
+
+    serving = ColocatedEngine(
+        cfg.replace(
+            dtype="bfloat16" if model_scale == "0p6b" else "float32",
+            param_dtype="bfloat16" if model_scale == "0p6b" else "float32",
+            remat=False,
+        ),
+        params=actor._export_params(),
+        n_slots=n_slots,
+        max_seq_len=max_seq_len,
+        prompt_bucket=128,
+        decode_chunk=8,
+    )
+    return actor, serving, cfg
+
+
+def _train_consume(actor, batch):
+    batch["prox_logp"] = actor.compute_logp(batch)
+    actor.compute_advantages(batch)
+    stats = actor.ppo_update(batch)
+    return stats
+
+
+def _batch_tokens(batch) -> int:
+    return int(np.asarray(batch["attention_mask"]).sum())
+
+
+def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
+             steps: int, warmup: int = 1):
+    """-> {trajs_per_sec, effective_tokens_per_sec, steps, pause_s_mean}"""
+    from areal_tpu.api.config import InferenceEngineConfig
+    from areal_tpu.core.executor import WorkflowExecutor
+    from areal_tpu.utils.dataloader import StatefulDataLoader
+
+    executor = None
+    if mode == "async":
+        executor = WorkflowExecutor(
+            InferenceEngineConfig(
+                experiment_name="e2e-bench", trial_name="b",
+                consumer_batch_size=batch_size,
+                max_concurrent_rollouts=batch_size * 2,
+                max_head_offpolicyness=4,
+                request_timeout=600,
+            ),
+            serving,
+        )
+        executor.initialize()
+        dataloader = StatefulDataLoader(dataset, batch_size=batch_size, seed=0)
+
+    data_iter = iter(np.random.default_rng(1).permutation(len(dataset)))
+
+    def next_sync_batch():
+        items = []
+        for _ in range(batch_size):
+            items.append(dataset[int(next(data_iter)) % len(dataset)])
+        return items
+
+    trajs = tokens = 0
+    pauses = []
+    version = serving.get_version()
+    t_start = None
+    try:
+        for step in range(warmup + steps):
+            if step == warmup:
+                import jax
+
+                jax.block_until_ready(actor.params)
+                trajs = tokens = 0
+                pauses = []
+                t_start = time.perf_counter()
+            if mode == "async":
+                batch = executor.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = serving.rollout_batch(next_sync_batch(),
+                                              workflow=workflow)
+            trajs += int(np.asarray(batch["attention_mask"]).shape[0])
+            tokens += _batch_tokens(batch)
+            _train_consume(actor, batch)
+            version += 1
+            actor.set_version(version)
+            pauses.append(
+                serving.update_weights_in_memory(actor._export_params(),
+                                                 version)
+            )
+            # the executor reads the new version via serving.get_version()
+            print(f"{mode} step {step}: trajs={trajs} tokens={tokens}",
+                  file=sys.stderr, flush=True)
+        import jax
+
+        actor.flush_stats()
+        jax.block_until_ready(actor.params)
+        wall = time.perf_counter() - t_start
+    finally:
+        if executor is not None:
+            executor.destroy()
+    return {
+        "steps": steps,
+        "trajectories": trajs,
+        "effective_tokens": tokens,
+        "wall_s": round(wall, 2),
+        "trajs_per_sec_per_chip": round(trajs / wall, 3),
+        "effective_tokens_per_sec_per_chip": round(tokens / wall, 1),
+        "pause_window_s_mean": round(float(np.mean(pauses)), 3),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="0p6b", choices=["0p6b", "tiny"])
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--group-size", type=int, default=2)
+    p.add_argument("--n-slots", type=int, default=16)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--prompt-len", type=int, default=64)
+    p.add_argument("--max-new-tokens", type=int, default=128)
+    p.add_argument("--modes", default="sync,async")
+    args = p.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the baked TPU plugin forces jax_platforms at interpreter boot;
+        # re-apply the env choice so CPU smoke runs stay off the chip
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from areal_tpu.api.config import GenerationHyperparameters
+    from areal_tpu.api.reward import prewarm_reward_pool
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    actor, serving, cfg = _make_parts(
+        args.model, args.n_slots, args.max_seq_len, args.group_size
+    )
+    prewarm_reward_pool()
+    workflow = RLVRWorkflow(
+        reward_fn=_reward_any_even,
+        gconfig=GenerationHyperparameters(
+            n_samples=args.group_size,
+            max_new_tokens=args.max_new_tokens,
+            temperature=1.0,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    dataset = [
+        {"input_ids": rng.integers(0, cfg.vocab_size,
+                                   args.prompt_len).tolist(),
+         "query_id": str(i)}
+        for i in range(256)
+    ]
+    result = {
+        "model": args.model,
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": args.batch_size,
+        "group_size": args.group_size,
+        "max_new_tokens": args.max_new_tokens,
+    }
+    for mode in args.modes.split(","):
+        result[mode] = run_mode(
+            mode, actor, serving, workflow, dataset, args.batch_size,
+            args.steps,
+        )
+    if "sync" in result and "async" in result:
+        result["async_over_sync_trajs_per_sec"] = round(
+            result["async"]["trajs_per_sec_per_chip"]
+            / result["sync"]["trajs_per_sec_per_chip"], 3,
+        )
+    serving.destroy()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,11 +29,12 @@ def _rand(n=48, d=16, v=96, seed=0, dtype=np.float32):
     return h, w, labels
 
 
-def test_vocab_chunk_divides():
-    assert _vocab_chunk(151936, 8192) == 4748  # qwen2.5 vocab: 2^7 * 1187
-    assert 151936 % _vocab_chunk(151936, 8192) == 0
-    assert _vocab_chunk(96, 32) == 32
-    assert _vocab_chunk(7, 100) == 7
+def test_vocab_chunk_mxu_aligned():
+    # chunks are 128-multiples (MXU lane width); the padded tail is masked
+    assert _vocab_chunk(151936, 8192) == 8192  # qwen2.5: 18 full + 1 partial
+    assert _vocab_chunk(96, 32) == 128  # small vocabs pad up to one chunk
+    assert _vocab_chunk(7, 100) == 128
+    assert _vocab_chunk(151936, 8192) % 128 == 0
 
 
 @pytest.mark.parametrize("v,chunk", [(96, 32), (96, 96), (90, 32), (7, 4)])
