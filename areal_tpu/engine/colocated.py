@@ -148,9 +148,14 @@ class ColocatedEngine:
             fut: asyncio.Future = loop.create_future()
 
             def _done(gr: GenRequest, fut=fut, loop=loop):
-                loop.call_soon_threadsafe(
-                    lambda: fut.done() or fut.set_result(gr)
-                )
+                try:
+                    loop.call_soon_threadsafe(
+                        lambda: fut.done() or fut.set_result(gr)
+                    )
+                except RuntimeError:
+                    # the caller's event loop is gone (teardown abort of a
+                    # request whose client already left) — nothing to wake
+                    pass
 
             budget = g.max_new_tokens - len(accumulated)
             gr = GenRequest(

@@ -595,6 +595,26 @@ class JaxTrainEngine(TrainEngine):
 
         return merge_lora(self._host_params(), self.model_config)
 
+    def export_device_params(self):
+        """Serving-ready bf16 params WITHOUT leaving the device — the
+        colocated publish path (engine/colocated.py): trainer and serving
+        engine share the chips, so the disk/host round trip of the other
+        publish modes is pure waste there.  Leaves are COPIES (jnp.array
+        copy=True), so the trainer's next donated update cannot invalidate
+        the serving engine's buffers.  LoRA folds on the host path only —
+        adapters make this fall back to _export_params."""
+        if self.model_config.lora_rank > 0:
+            return self._export_params()
+        # keep the configured param_dtype: an fp32 smoke config must stay
+        # fp32 or the serving engine retraces mid-measurement
+        target = jnp.dtype(self.model_config.param_dtype)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.array(x, target, copy=True)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            self.params,
+        )
+
     def update_weights(self, meta: WeightUpdateMeta) -> None:
         """Publish fresh weights to inference servers.
 

@@ -252,7 +252,40 @@ def main():
     except Exception as e:  # noqa: BLE001
         print(f"bench: 32k ctx variant failed: {str(e)[:120]}", file=sys.stderr)
 
+    # serving-side probe (VERDICT r3 #1): decode throughput with a busy
+    # 64-slot grid + the multi-turn KV-prefix-reuse gain, on the same chip.
+    # BENCH_SERVING=0 skips (the full curve lives in scripts/bench_serving.py
+    # -> SERVING_BENCH_r{N}.json; the e2e async-vs-sync loop in
+    # scripts/bench_e2e_grpo.py -> E2E_GRPO_BENCH_r{N}.json).
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        try:
+            serving = _serving_probe()
+            result.update(serving)
+        except Exception as e:  # noqa: BLE001 — informational extras
+            print(f"bench: serving probe failed: {str(e)[:120]}", file=sys.stderr)
+
     print(json.dumps(result))
+
+
+def _serving_probe():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import bench_serving as bs
+
+    cfg, params = bs.serving_model_setup()
+    decode = bs.bench_decode(cfg, params, [64], max_seq_len=512,
+                             gen_tokens=128, prompt_len=64)
+    mt = bs.bench_multi_turn(cfg, params, n_convs=8, turns=3,
+                             turn_prompt=64, turn_gen=32, max_seq_len=1024)
+    out = {}
+    if "64" in decode and "tokens_per_sec" in decode["64"]:
+        out["serving_decode_tok_s_64slots"] = decode["64"]["tokens_per_sec"]
+    out["serving_multiturn_kv_reuse_speedup"] = mt["speedup"]
+    out["serving_multiturn_prefill_tokens_saved_frac"] = round(
+        mt["reuse"]["reused_tokens"]
+        / max(1, mt["cold"]["prefill_tokens"]), 3,
+    )
+    return out
 
 
 if __name__ == "__main__":

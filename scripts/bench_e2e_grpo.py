@@ -169,9 +169,12 @@ def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
             _train_consume(actor, batch)
             version += 1
             actor.set_version(version)
+            # device-to-device handoff: both sides share the chip, so the
+            # publish never touches the host (export_device_params)
             pauses.append(
-                serving.update_weights_in_memory(actor._export_params(),
-                                                 version)
+                serving.update_weights_in_memory(
+                    actor.export_device_params(), version
+                )
             )
             # the executor reads the new version via serving.get_version()
             print(f"{mode} step {step}: trajs={trajs} tokens={tokens}",
@@ -255,8 +258,14 @@ def main():
             result["async"]["trajs_per_sec_per_chip"]
             / result["sync"]["trajs_per_sec_per_chip"], 3,
         )
-    serving.destroy()
+    # the result line must survive teardown hiccups (stale request
+    # callbacks etc.) — print FIRST, clean up after
     print(json.dumps(result))
+    sys.stdout.flush()
+    try:
+        serving.destroy()
+    except Exception as e:  # noqa: BLE001 — teardown only
+        print(f"teardown: {str(e)[:120]}", file=sys.stderr)
 
 
 if __name__ == "__main__":

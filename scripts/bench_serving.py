@@ -36,6 +36,27 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def serving_model_setup():
+    """The canonical serving-bench model: Qwen2.5-1.5B shapes, bf16,
+    random weights.  Shared with bench.py's quick probe so the headline
+    serving numbers and SERVING_BENCH_r{N}.json can never desynchronise."""
+    import jax
+
+    from areal_tpu.models import init_params
+    from areal_tpu.models.model_config import qwen25_1p5b
+
+    cfg = qwen25_1p5b().replace(
+        dtype="bfloat16", param_dtype="bfloat16", remat=False,
+        eos_token_id=None,
+    )
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _reset_stats(eng):
+    for k in eng.stats:
+        eng.stats[k] = 0
+
+
 def _engine(cfg, params, n_slots, max_seq_len, kv_reuse=True, decode_chunk=8):
     from areal_tpu.gen.engine import GenEngine
 
@@ -63,6 +84,7 @@ def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
                 for i in range(n_slots)
             ]
             eng.generate_blocking(reqs)
+            _reset_stats(eng)  # warmup compiles must not skew counters
             # measured run: fixed budget per slot, no stop tokens
             reqs = [
                 GenRequest(rid=f"m{i}",
@@ -143,6 +165,8 @@ def bench_multi_turn(cfg, params, n_convs=8, turns=4, turn_prompt=64,
         warm = [GenRequest(rid="w", input_ids=[1] * turn_prompt,
                            max_new_tokens=2, temperature=1.0)]
         eng.generate_blocking(warm)
+        _reset_stats(eng)  # the warmup request must not skew the token accounting
+        eng.retained_len[:] = 0  # nor seed a reusable prefix
         transcripts = [
             rng.integers(0, cfg.vocab_size, turn_prompt).tolist()
             for _ in range(n_convs)
@@ -191,14 +215,7 @@ def main():
         # re-apply the env choice so CPU smoke runs stay off the chip
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from areal_tpu.models import init_params
-    from areal_tpu.models.model_config import qwen25_1p5b
-
-    cfg = qwen25_1p5b().replace(
-        dtype="bfloat16", param_dtype="bfloat16", remat=False,
-        eos_token_id=None,
-    )
-    params = init_params(cfg, jax.random.PRNGKey(0))
+    cfg, params = serving_model_setup()
     result = {"model": "qwen25_1p5b", "device_kind": jax.devices()[0].device_kind}
     if not args.skip_decode:
         result["decode"] = bench_decode(

@@ -313,15 +313,20 @@ def test_staged_weight_sync_splits_push_from_commit(tmp_path):
         meta = WeightUpdateMeta.from_transfer("e2e-st", "t", chunk_mb=1)
         actor.set_version(1)
         actor.stage_weights(meta)
-        # staged but NOT swapped: server still serves version 0 un-paused
+        # staged but NOT swapped: server still serves version 0 un-paused.
+        # Staging now goes all the way to DEVICE (the standby tree), so the
+        # later commit is a pointer swap — the chunk buffer is already
+        # drained by the `prepare` message.
         assert engine.version == 0
-        assert server._chunk_buf, "chunks must be staged server-side"
+        assert engine.has_standby and engine.staged_version == 1
+        assert not server._chunk_buf
         assert not server.paused.is_set()
         t0 = time.perf_counter()
         actor.update_weights(meta)  # commit only
         commit_s = time.perf_counter() - t0
         assert engine.version == 1
-        assert not server._chunk_buf  # consumed by the commit
+        assert not engine.has_standby  # consumed by the commit
+        assert engine.last_pause_s <= commit_s
         # staged state is single-use: a second update re-pushes
         actor.set_version(2)
         actor.update_weights(meta)
