@@ -51,6 +51,14 @@ class ColocatedEngine:
     def start_serving(self) -> None:
         if self._serving:
             return
+        if self._stepper is not None and self._stepper.is_alive():
+            # a previous stop_serving timed out and left its thread wedged
+            # in step(); spawning a second stepper would race it on the
+            # non-thread-safe engine
+            raise RuntimeError(
+                "previous serving stepper is still wedged in a decode "
+                "step; cannot start a second one"
+            )
         self._stop.clear()
 
         def _loop():
@@ -73,7 +81,28 @@ class ColocatedEngine:
             return
         self._stop.set()
         if self._stepper is not None:
-            self._stepper.join(timeout=30)
+            # the stepper MUST be parked before callers mutate engine state
+            # (weight swap, HBM release): proceeding while a step() is
+            # wedged — e.g. a first XLA compile of a new decode bucket —
+            # would race the swap and let start_serving spawn a SECOND
+            # thread into the non-thread-safe engine.  Wait as long as it
+            # takes, loudly; only a dead-for-minutes step is fatal.
+            deadline = time.monotonic() + 600
+            while self._stepper.is_alive():
+                self._stepper.join(timeout=30)
+                if self._stepper.is_alive():
+                    if time.monotonic() > deadline:
+                        # truthful state for whoever catches this: we are
+                        # not serving; _stepper stays set so start_serving
+                        # refuses to spawn a second thread beside it
+                        self._serving = False
+                        raise RuntimeError(
+                            "serving stepper failed to park within 600s; "
+                            "refusing to mutate engine state under a live "
+                            "decode thread"
+                        )
+                    logger.warning("waiting for in-flight decode step to "
+                                   "finish before parking the stepper")
         self._stepper = None
         self._serving = False
 
@@ -102,15 +131,24 @@ class ColocatedEngine:
         self.engine.restage(params=host_params, version=version)
         self.start_serving()
 
-    def update_weights_in_memory(self, host_params, version: int) -> float:
+    def update_weights_in_memory(self, host_params, version: int,
+                                 interrupt: bool = False) -> float:
         """Publish WITHOUT releasing serving HBM (both sides resident —
-        the async colocated regime): pause the stepper, swap weights via
-        the engine's abort-and-reload (in-flight requests resume through
-        agenerate's interruption loop), restart.  Returns the achieved
-        generation pause window in seconds."""
+        the async colocated regime): park the stepper between decode
+        chunks, swap weights, restart.  Returns the achieved
+        generation-idle window in seconds.
+
+        Default is the LIVE swap (`GenEngine.swap_weights_live`): in-flight
+        requests keep slots + KV and keep decoding under the new policy,
+        per-token versions recording the transition — no abort, no
+        re-prefill.  `interrupt=True` keeps the abort-and-resume
+        choreography (the remote fleet's contract) for A/B measurement."""
         self.stop_serving()
         t0 = time.perf_counter()
-        self.engine.load_weights(params=host_params, version=version)
+        if interrupt:
+            self.engine.load_weights(params=host_params, version=version)
+        else:
+            self.engine.swap_weights_live(host_params, version=version)
         pause = time.perf_counter() - t0
         self.start_serving()
         return pause

@@ -367,10 +367,6 @@ class GenEngine:
         aborted = self.abort_all("abort")
         if aborted:
             logger.info(f"aborted {aborted} requests for weight update")
-        if not self.retain_kv_on_reload:
-            # strict mode: drop every retained prefix so resumes recompute
-            # their full context under the new policy
-            self.retained_len[:] = 0
         if params is None:
             assert path is not None
             path, dir_version = self._resolve_ckpt_dir(path)
@@ -380,15 +376,60 @@ class GenEngine:
                 # while the trainer is at N (staleness gates compare them)
                 version = dir_version
             params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
+        self.swap_weights_live(params, version=version)
+        # achieved generation-idle window for the unstaged ABORT path spans
+        # the abort + checkpoint load + host->device placement, not just the
+        # swap tail (staged swaps record theirs in commit_staged)
+        self.last_pause_s = time.perf_counter() - t0
+        return self.version
+
+    def swap_weights_live(self, params, version: Optional[int] = None) -> int:
+        """Non-aborting weight swap — the colocated in-memory publish.
+
+        In-flight requests keep their slots and KV and continue decoding
+        under the NEW policy from the next chunk on; per-token
+        `output_versions` record the transition, which is exactly the
+        mixed-version trajectory the decoupled loss's behavior weight is
+        built to consume (reference interruptible generation,
+        blog/AReaL_v0_3.md:203-207, achieves the same semantics by
+        abort+resume because SGLang cannot hot-swap mid-request — here the
+        params tree is one pointer read per dispatch, so nothing needs to
+        die).  KV computed under the old weights stays, matching the radix
+        cache the reference leans on (remote_inf_engine.py:404-413).
+
+        Callers must not race a swap against an in-flight `step()` if they
+        care about exact version stamping (ColocatedEngine parks the
+        stepper first); the swap itself is atomic either way.
+
+        `load_weights` (the aborting path) delegates here for the shared
+        publish tail, so every swap invariant lives in one place.
+        """
         if self.model_config.vision is not None and "vision" not in params:
             # text-only update for a VLM: keep the current tower (already
             # sharded on device; device_put under the same spec is a no-op)
             params = dict(params)
             params["vision"] = self.params["vision"]
+        t0 = time.perf_counter()
         self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.version = version if version is not None else self.version + 1
-        # achieved generation-idle window for the unstaged path (staged
-        # swaps record theirs in commit_staged)
+        if not self.retain_kv_on_reload:
+            # strict mode applies to EVERY weight-swap path: retained
+            # prefixes hold old-policy KV and must not seed suffix prefills
+            self.retained_len[:] = 0
+        if getattr(self, "_standby", None) is not None:
+            staged_v = self._standby[1]
+            if staged_v is None or staged_v <= self.version:
+                # the pre-staged tree is not newer than what we just
+                # published: committing it later would silently ROLL BACK
+                # the version, and keeping it pins a full bf16 param copy
+                # of HBM
+                logger.warning(
+                    "weight publish discarding non-newer standby (staged "
+                    f"v{staged_v}, now v{self.version})"
+                )
+                self._standby = None
+            # a STRICTLY NEWER standby (e.g. v6 staged via prepare while a
+            # v5 disk publish lands) stays valid for its pending commit
         self.last_pause_s = time.perf_counter() - t0
         return self.version
 

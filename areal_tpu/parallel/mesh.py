@@ -104,9 +104,16 @@ def shard_pytree(mesh: Mesh, tree: Any, specs: Any) -> Any:
         )
 
     def _make(x, s):
+        sharding = NamedSharding(mesh, s)
+        if isinstance(x, jax.Array):
+            # already a device array (e.g. the trainer's params in a
+            # colocated publish): reshard device-to-device — np.asarray
+            # would gather through the host (and raise outright on
+            # non-addressable shards)
+            return jax.device_put(x, sharding)
         x = np.asarray(x)
         return jax.make_array_from_callback(
-            x.shape, NamedSharding(mesh, s), lambda idx, x=x: x[idx]
+            x.shape, sharding, lambda idx, x=x: x[idx]
         )
 
     return jax.tree_util.tree_map(_make, tree, specs)

@@ -117,7 +117,7 @@ def _batch_tokens(batch) -> int:
 
 
 def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
-             steps: int, warmup: int = 1):
+             steps: int, warmup: int = 1, interrupt_publish: bool = False):
     """-> {trajs_per_sec, effective_tokens_per_sec, steps, pause_s_mean}"""
     from areal_tpu.api.config import InferenceEngineConfig
     from areal_tpu.core.executor import WorkflowExecutor
@@ -173,7 +173,8 @@ def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
             # publish never touches the host (export_device_params)
             pauses.append(
                 serving.update_weights_in_memory(
-                    actor.export_device_params(), version
+                    actor.export_device_params(), version,
+                    interrupt=interrupt_publish,
                 )
             )
             # the executor reads the new version via serving.get_version()
@@ -209,6 +210,11 @@ def main():
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--modes", default="sync,async")
+    p.add_argument("--publish-mode", default="live",
+                   choices=["live", "interrupt"],
+                   help="live = non-aborting swap_weights_live (colocated "
+                        "default); interrupt = abort-and-resume (the remote "
+                        "fleet's choreography) for A/B comparison")
     args = p.parse_args()
 
     import jax
@@ -247,11 +253,12 @@ def main():
         "batch_size": args.batch_size,
         "group_size": args.group_size,
         "max_new_tokens": args.max_new_tokens,
+        "publish_mode": args.publish_mode,
     }
     for mode in args.modes.split(","):
         result[mode] = run_mode(
             mode, actor, serving, workflow, dataset, args.batch_size,
-            args.steps,
+            args.steps, interrupt_publish=args.publish_mode == "interrupt",
         )
     if "sync" in result and "async" in result:
         result["async_over_sync_trajs_per_sec"] = round(

@@ -35,6 +35,7 @@ class FakeGenServer:
         self.version = 0
         self.paused = False
         self.abort_once = False
+        self.delay_s = 0.0  # holds /generate in flight (load-balancing tests)
         self.requests: List[dict] = []
         self.weight_updates: List[dict] = []
         self.port: Optional[int] = None
@@ -47,6 +48,8 @@ class FakeGenServer:
     async def _generate(self, request: web.Request):
         body = await request.json()
         self.requests.append(body)
+        if self.delay_s:
+            await asyncio.sleep(self.delay_s)
         prompt = body["input_ids"]
         params = body["sampling_params"]
         budget = params["max_new_tokens"]

@@ -142,6 +142,83 @@ def test_weight_update_aborts_and_bumps_version(setup):
     engine.load_weights(params=params)
 
 
+def test_live_swap_keeps_requests_decoding(setup):
+    """swap_weights_live mid-generation: no abort, no re-prefill — the
+    in-flight request keeps decoding under the NEW policy and its per-token
+    versions record the transition (the colocated publish path)."""
+    cfg, params, _ = setup
+    import jax
+
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, 97, 6).tolist()
+    eng = _fresh_engine(cfg, params)
+    req = GenRequest(rid="lv", input_ids=prompt, max_new_tokens=12,
+                     temperature=0.0)
+    eng.submit(req)
+    while len(req.output_tokens) < 4:
+        eng.step(chunk=2)
+    pre_swap = len(req.output_tokens)
+    prefills_before = eng.stats["prefill_calls"] + eng.stats["suffix_calls"]
+    new_params = init_params(cfg, jax.random.PRNGKey(123))
+    v = eng.swap_weights_live(new_params)
+    assert v == 1 and eng.last_pause_s >= 0
+    assert not req.stop_reason  # still in flight — nothing aborted
+    while not req.stop_reason:
+        eng.step(chunk=2)
+    assert req.stop_reason == "length"
+    assert len(req.output_tokens) == 12
+    # both policies contributed tokens, recorded per token
+    assert set(req.output_versions) == {0, 1}
+    assert req.output_versions[:pre_swap] == [0] * pre_swap
+    assert req.output_versions[-1] == 1
+    # no re-prefill happened: decoding continued on the same slot/KV
+    assert eng.stats["prefill_calls"] + eng.stats["suffix_calls"] \
+        == prefills_before
+    # a fresh request (distinct prompt — no retained-prefix match, which
+    # would deliberately reuse old-policy KV) is pure new-policy
+    p2 = rng.integers(0, 97, 6).tolist()
+    r2 = GenRequest(rid="lv2", input_ids=p2, max_new_tokens=4,
+                    temperature=0.0)
+    eng.generate_blocking([r2])
+    assert r2.output_tokens == _greedy_reference(cfg, new_params, p2, 4)
+
+
+def test_live_swap_honors_strict_reload_and_drops_stale_standby(setup):
+    """swap_weights_live must (a) clear retained prefixes under
+    retain_kv_on_reload=False — strict mode promises resumes recompute
+    under the new policy — and (b) invalidate a pre-staged standby tree,
+    or a later commit_staged would silently roll the version BACK."""
+    cfg, params, _ = setup
+    import jax
+
+    rng = np.random.default_rng(21)
+    prompt = rng.integers(0, 97, 8).tolist()
+    eng = _fresh_engine(cfg, params, retain_kv_on_reload=False)
+    r1 = GenRequest(rid="s", input_ids=prompt, max_new_tokens=4,
+                    temperature=0.0)
+    eng.generate_blocking([r1])
+    assert any(eng.retained_len)  # finished slot retains its prefix...
+    p1 = init_params(cfg, jax.random.PRNGKey(7))
+    assert eng.stage_params(p1, version=1) and eng.has_standby
+    p2 = init_params(cfg, jax.random.PRNGKey(8))
+    eng.swap_weights_live(p2, version=2)
+    # ...until a strict-mode swap wipes it
+    assert not any(eng.retained_len)
+    # and the older staged tree cannot be committed over the newer publish
+    assert not eng.has_standby
+    assert eng.version == 2
+    with pytest.raises(RuntimeError):
+        eng.commit_staged()
+
+    # a STRICTLY NEWER standby survives an older publish: its pending
+    # commit must not be lost (staged v6 vs disk publish v5 race)
+    p3 = init_params(cfg, jax.random.PRNGKey(9))
+    assert eng.stage_params(p3, version=6)
+    eng.load_weights(params=p2, version=5)
+    assert eng.has_standby and eng.staged_version == 6
+    assert eng.commit_staged() == 6
+
+
 def test_prompt_too_long_rejected(setup):
     cfg, params, engine = setup
     req = GenRequest(rid="x", input_ids=list(range(90)) + list(range(40)),
