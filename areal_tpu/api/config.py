@@ -389,6 +389,14 @@ class GRPOConfig(BaseExperimentConfig):
     # simple correct default) or "transfer" (HTTP chunk streaming straight
     # into server memory — no shared filesystem, lower latency at scale)
     weight_update_mode: str = "disk"
+    # transfer mode only: commit staged weights WITHOUT aborting in-flight
+    # generation (swap_weights_live — requests keep decoding across the
+    # publish, per-token versions record the transition)
+    weight_update_live_commit: bool = False
+    # (n_sequences, seq_len) pack signatures to AOT-compile before step 0
+    # (PPOActor.warm_shapes): varying rollout lengths otherwise trigger XLA
+    # compiles INSIDE the training loop the first time each signature lands
+    warm_pack_shapes: List[List[int]] = field(default_factory=list)
     gconfig: GenerationHyperparameters = field(
         default_factory=GenerationHyperparameters
     )

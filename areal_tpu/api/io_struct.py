@@ -133,6 +133,11 @@ class WeightUpdateMeta:
     alloc_mode: Optional["AllocationMode"] = None
     chunk_mb: int = 256
     use_lora: bool = False
+    # transfer commits only: swap without aborting in-flight generation
+    # (GenEngine.swap_weights_live semantics — requests keep decoding, the
+    # policy transition is recorded in per-token versions).  Default keeps
+    # the abort-and-resume interruption choreography.
+    live_commit: bool = False
     # identify the trial for the name_resolve version handshake
     experiment_name: str = ""
     trial_name: str = ""
@@ -169,11 +174,13 @@ class WeightUpdateMeta:
         trial_name: str = "",
         alloc_mode: Optional["AllocationMode"] = None,
         chunk_mb: int = 256,
+        live_commit: bool = False,
     ) -> "WeightUpdateMeta":
         return cls(
             type="transfer",
             alloc_mode=alloc_mode,
             chunk_mb=chunk_mb,
+            live_commit=live_commit,
             experiment_name=experiment_name,
             trial_name=trial_name,
         )

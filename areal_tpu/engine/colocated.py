@@ -77,7 +77,12 @@ class ColocatedEngine:
         self._serving = True
 
     def stop_serving(self) -> None:
-        if not self._serving:
+        if not self._serving and not (
+            self._stepper is not None and self._stepper.is_alive()
+        ):
+            # a wedged stepper left behind by a timed-out stop (below) must
+            # still be waited out here, or callers proceed to mutate the
+            # engine under the live thread the guard exists to prevent
             return
         self._stop.set()
         if self._stepper is not None:

@@ -399,7 +399,104 @@ class JaxTrainEngine(TrainEngine):
             stats["lr"] = schedule(step_idx)
             return new_params, new_opt_state, stats
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        # pin state outputs to the CURRENT shardings: without this, GSPMD
+        # is free to re-layout the updated params/opt-state however it
+        # likes — on a real mesh that silently abandons the intended
+        # fsdp/tp distribution after step 1, and every downstream program
+        # consuming params (forward, export, serving publish) retraces
+        # against the drifted shardings (one surprise compile each)
+        def shard_of(x):
+            return getattr(x, "sharding", None)
+
+        out_shardings = (
+            jax.tree_util.tree_map(shard_of, self.params),
+            jax.tree_util.tree_map(shard_of, self.opt_state),
+            None,  # stats: let XLA choose (replicated scalars)
+        )
+        return jax.jit(
+            train_step, donate_argnums=(0, 1), out_shardings=out_shardings
+        )
+
+    # keys the jitted forward program may read (the _call_model seam plus
+    # the in-tree post-hooks).  forward() filters the packed batch to these
+    # so EXTRA rollout keys (rewards, versions, loss_mask, ...) and their
+    # pipeline-dependent dtypes can never change the jit cache signature —
+    # workflows adding fields must not trigger surprise in-loop recompiles,
+    # and warm_shapes' synthetic batches compile the very program the real
+    # call requests.  Subclasses with richer model seams extend (VLM adds
+    # pixels/mrope); custom post_hooks reading other per-token keys must
+    # extend it too.
+    FORWARD_KEYS = ("input_ids", "positions", "segment_ids")
+
+    def _forward_batch_view(self, data: Dict[str, np.ndarray]):
+        return {k: data[k] for k in self.FORWARD_KEYS if k in data}
+
+    def _forward_fn_for(self, post_hook, row_len: int, n_rows: int):
+        """Resolve (building + caching if needed) the jitted forward for a
+        (hook, shape) signature; returns the cache key."""
+        if post_hook is None:
+            post_hook = _logp_hook
+        key = ("fwd", post_hook, row_len, n_rows)
+        if key not in self._forward_cache:
+            call_model = self._call_model
+
+            def fwd_step(params, batch):
+                logits = call_model(params, batch)
+                return post_hook(logits, batch)
+
+            # multi-process: output rows are sharded across hosts — jit
+            # replicates them so every process can read the full array
+            out_shardings = (
+                NamedSharding(self.mesh, P())
+                if jax.process_count() > 1
+                else None
+            )
+            self._forward_cache[key] = jax.jit(
+                fwd_step, out_shardings=out_shardings
+            )
+        return key
+
+    def precompile_forward(
+        self,
+        input_: Dict[str, np.ndarray],
+        post_hook: Optional[Callable] = None,
+    ) -> None:
+        """AOT-compile the no-grad forward for this batch's shape signature
+        (see precompile_train_batch)."""
+        assert self.initialized
+        rp, data, row_len = self._prepare_rows(input_, 1)
+        dev_batch = self._device_batch(self._forward_batch_view(data),
+                                       stacked=False)
+        key = self._forward_fn_for(post_hook, row_len,
+                                   data["input_ids"].shape[0])
+        with self.mesh:
+            self._forward_cache[key].lower(self.params, dev_batch).compile()
+
+    def precompile_train_batch(
+        self, input_: Dict[str, np.ndarray], loss_fn: Callable
+    ) -> None:
+        """Compile the train-step program for this batch's shape signature
+        WITHOUT executing it.  AOT `jit.lower(...).compile()` populates the
+        same executable cache the real call uses (measured: the next real
+        call is a cache hit), and — unlike executing a warm step — donates
+        nothing and mutates nothing.  PPOActor.warm_shapes drives this so
+        varying rollout lengths never compile inside the training loop."""
+        assert self.initialized and self._optimizer is not None
+        n_mbs = max(1, self.config.mb_spec.n_mbs)
+        rp, data, row_len = self._prepare_rows(input_, n_mbs)
+        stacked = self._stack_mbs(data, n_mbs)
+        dev_batch = self._device_batch(stacked, stacked=True)
+        key = (loss_fn, n_mbs, row_len, stacked["input_ids"].shape[1])
+        if key not in self._train_step_cache:
+            self._train_step_cache[key] = self._build_train_step(loss_fn)
+        with self.mesh:
+            self._train_step_cache[key].lower(
+                self.params,
+                self.opt_state,
+                dev_batch,
+                jnp.float32(1.0),
+                jnp.int32(self.step_count),
+            ).compile()
 
     def train_batch(
         self,
@@ -539,29 +636,10 @@ class JaxTrainEngine(TrainEngine):
                 "outputs to aggregate; post-process the returned array instead"
             )
         rp, data, row_len = self._prepare_rows(input_, 1)
-        dev_batch = self._device_batch(data, stacked=False)
-
-        if post_hook is None:
-            post_hook = _logp_hook
-        key = ("fwd", post_hook, row_len, data["input_ids"].shape[0])
-        if key not in self._forward_cache:
-
-            call_model = self._call_model
-
-            def fwd_step(params, batch):
-                logits = call_model(params, batch)
-                return post_hook(logits, batch)
-
-            # multi-process: output rows are sharded across hosts — jit
-            # replicates them so every process can read the full array
-            out_shardings = (
-                NamedSharding(self.mesh, P())
-                if jax.process_count() > 1
-                else None
-            )
-            self._forward_cache[key] = jax.jit(
-                fwd_step, out_shardings=out_shardings
-            )
+        dev_batch = self._device_batch(self._forward_batch_view(data),
+                                       stacked=False)
+        key = self._forward_fn_for(post_hook, row_len,
+                                   data["input_ids"].shape[0])
         with self.mesh:
             out = self._forward_cache[key](self.params, dev_batch)
             if jax.process_count() > 1:
@@ -841,7 +919,8 @@ class JaxTrainEngine(TrainEngine):
                 arequest_with_retry(
                     addr=a,
                     endpoint="/update_weights_chunk",
-                    payload={"commit": True, "version": version},
+                    payload={"commit": True, "version": version,
+                             "live": meta.live_commit},
                     method="POST",
                     timeout=600.0,
                 )

@@ -93,22 +93,28 @@ class PPOActor:
     def compute_logp(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         """Recompute current-policy logprobs (predictor-aligned [B, L]);
         the proximal policy of the decoupled objective."""
-        temp = self.config.temperature
+        return self.engine.forward(batch, post_hook=self._get_logp_hook())
 
-        def hook(model_out, mb):
-            import jax.numpy as jnp
-
-            from areal_tpu.ops.functional import lm_logprobs_entropy
-
-            labels = jnp.roll(mb["input_ids"], -1, axis=-1)
-            logp, _, _ = lm_logprobs_entropy(
-                model_out, labels, temperature=temp, with_entropy=False
-            )
-            return logp
-
+    def _get_logp_hook(self):
+        """The logp post-hook, built once — the jitted forward is keyed on
+        the callable's identity, so compute_logp and warm_shapes must hand
+        the engine the SAME object."""
         if not hasattr(self, "_logp_hook"):
+            temp = self.config.temperature
+
+            def hook(model_out, mb):
+                import jax.numpy as jnp
+
+                from areal_tpu.ops.functional import lm_logprobs_entropy
+
+                labels = jnp.roll(mb["input_ids"], -1, axis=-1)
+                logp, _, _ = lm_logprobs_entropy(
+                    model_out, labels, temperature=temp, with_entropy=False
+                )
+                return logp
+
             self._logp_hook = hook
-        return self.engine.forward(batch, post_hook=self._logp_hook)
+        return self._logp_hook
 
     # ------------------------------------------------------------------
 
@@ -240,6 +246,72 @@ class PPOActor:
             st.materialize()
         self._pending_stats.clear()
 
+    def warm_shapes(self, shapes) -> None:
+        """Precompile the PPO step programs for packed-batch shape
+        signatures, side-effect-free.
+
+        RL rollout lengths vary step to step, so the packer's
+        (rows, row_len) signature varies, and under jit each new signature
+        is a fresh XLA compile that otherwise lands INSIDE the training
+        loop (a torch-eager reference never sees this class of stall).
+        The shape space is already log-bounded (pow-2 row buckets x the
+        pack_length_quantum ladder, utils/data.py pack_into_rows); this
+        walks it up front through the REAL packer + jit plumbing, so the
+        compiled programs are exactly the ones the live loop will request.
+
+        Compilation is AOT (`jit.lower(...).compile()` via the engine's
+        precompile_* methods): nothing executes, nothing is donated, no
+        state changes — warming is exactly free of side effects.
+
+        shapes: iterable of (n_sequences, seq_len) pairs; each warms the
+        signature the packer produces for n full rows of seq_len.
+        n_sequences must respect the group-norm group size.
+        """
+        eng = self.engine
+        rng = np.random.default_rng(0)
+        # validate against the RESOLVED normalization groups (NormConfig
+        # group_size defaults to 1 and is overridden by config.group_size
+        # in __init__ — the raw config field is not what group_view asserts)
+        g = 1
+        for norm in (self.adv_norm, self.reward_norm):
+            if norm is not None:
+                g = max(g, norm.group_size)
+        if not hasattr(self, "_loss_fn"):
+            self._loss_fn = self._build_loss_fn()
+        for n_seqs, seq_len in shapes:
+            if n_seqs % g:
+                raise ValueError(
+                    f"warm shape n_sequences={n_seqs} must be divisible by "
+                    f"the adv-norm group size {g}"
+                )
+            V = eng.model_config.vocab_size
+            prompt = max(1, seq_len // 4)
+            loss_mask = np.zeros((n_seqs, seq_len), np.float32)
+            loss_mask[:, prompt:] = 1.0
+            batch = {
+                "input_ids": rng.integers(0, V, (n_seqs, seq_len)).astype(
+                    np.int32),
+                "attention_mask": np.ones((n_seqs, seq_len), bool),
+                "loss_mask": loss_mask,
+                "logprobs": rng.normal(-1.0, 0.1, (n_seqs, seq_len)).astype(
+                    np.float32),
+                "rewards": rng.integers(0, 2, n_seqs).astype(np.float32),
+            }
+            if self.config.recompute_logprob:
+                eng.precompile_forward(batch,
+                                       post_hook=self._get_logp_hook())
+            # advantages run host/numpy-side (plus a tiny gae program):
+            # executing them is cheap, touches no engine state, and yields
+            # the exact key-set ppo_update's loss view needs
+            batch["prox_logp"] = batch["logprobs"].copy()
+            self.compute_advantages(batch)
+            train_view = {k: batch[k] for k in self.LOSS_KEYS if k in batch}
+            mbs = split_padded_tensor_dict_into_mb_list(
+                train_view, n_mbs=self.config.ppo_n_minibatches
+            )
+            for mb in mbs.mbs:
+                eng.precompile_train_batch(mb, self._loss_fn)
+
     def _build_loss_fn(self):
         """The cached grpo loss partial (built ONCE: the compiled step is
         keyed on the callable's identity)."""
@@ -305,6 +377,9 @@ class JaxPPOActor(JaxTrainEngine):
 
     def ppo_update(self, batch: Dict[str, np.ndarray]) -> List[Dict[str, float]]:
         return self.actor.ppo_update(batch)
+
+    def warm_shapes(self, shapes) -> None:
+        self.actor.warm_shapes(shapes)
 
     def flush_stats(self) -> None:
         self.actor.flush_stats()

@@ -38,6 +38,12 @@ VISION_KEYS = VISION_PATCH_KEYS
 
 
 class JaxVLMEngine(JaxTrainEngine):
+    # the VLM model seam reads modality keys on top of the text ones
+    # (base FORWARD_KEYS doc in jax_train.py)
+    FORWARD_KEYS = JaxTrainEngine.FORWARD_KEYS + (
+        "pixel_values", "patch_img_ids", "mrope_positions", "patch_pos_hw",
+    )
+
     def __init__(
         self,
         config: TrainEngineConfig,
@@ -331,6 +337,14 @@ class JaxVLMPPOActor(JaxVLMEngine):
 
     def ppo_update(self, batch):
         return self.actor.ppo_update(batch)
+
+    def warm_shapes(self, shapes):
+        raise NotImplementedError(
+            "warm_shapes builds text-only synthetic batches; the VLM "
+            "forward reads pixel_values/patch_img_ids unconditionally, so "
+            "a modality-aware warm batch is needed (not yet implemented). "
+            "Leave warm_pack_shapes empty for VLM runs."
+        )
 
     def flush_stats(self):
         self.actor.flush_stats()

@@ -419,12 +419,16 @@ class GenEngine:
         if getattr(self, "_standby", None) is not None:
             staged_v = self._standby[1]
             if staged_v is None or staged_v <= self.version:
-                # the pre-staged tree is not newer than what we just
-                # published: committing it later would silently ROLL BACK
-                # the version, and keeping it pins a full bf16 param copy
-                # of HBM
+                # staged_v <= version: committing later would ROLL BACK the
+                # version.  staged_v None: its ordering vs this publish is
+                # unknowable, and a later commit would install the OLDER
+                # staged weights under a version bump (+1) — poisoning the
+                # staleness accounting that trusts versions to order
+                # policies.  Either way the standby must die (it also pins
+                # a full bf16 param copy of HBM); the commit's 409 tells
+                # the staging client to re-push.
                 logger.warning(
-                    "weight publish discarding non-newer standby (staged "
+                    "weight publish discarding superseded standby (staged "
                     f"v{staged_v}, now v{self.version})"
                 )
                 self._standby = None
@@ -466,22 +470,26 @@ class GenEngine:
     def has_standby(self) -> bool:
         return self._standby is not None
 
-    def commit_staged(self) -> int:
-        """Swap pre-staged weights in: abort in-flight + pointer swap — the
-        whole pause is O(abort), not O(model bytes).  Returns the version."""
+    def commit_staged(self, live: bool = False) -> int:
+        """Swap pre-staged weights in.  Default: abort in-flight + pointer
+        swap — the whole pause is O(abort), not O(model bytes).  `live=True`
+        skips the abort entirely (swap_weights_live semantics: in-flight
+        requests keep decoding, per-token versions record the transition).
+        Returns the version."""
         if getattr(self, "_standby", None) is None:
             raise RuntimeError("commit_staged without stage_params")
         t0 = time.perf_counter()
-        aborted = self.abort_all("abort")
-        if aborted:
-            logger.info(f"aborted {aborted} requests for staged weight swap")
+        if not live:
+            aborted = self.abort_all("abort")
+            if aborted:
+                logger.info(
+                    f"aborted {aborted} requests for staged weight swap"
+                )
         standby, version = self._standby
         self._standby = None
-        self.params = standby
-        self.version = version if version is not None else self.version + 1
-        if not self.retain_kv_on_reload:
-            # strict mode applies to EVERY weight-swap path, staged included
-            self.retained_len[:] = 0
+        # shared swap tail (device_put of the already-sharded standby under
+        # the same spec is a no-op, so this stays a pointer swap)
+        self.swap_weights_live(standby, version=version)
         self.last_pause_s = time.perf_counter() - t0
         return self.version
 

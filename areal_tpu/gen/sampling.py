@@ -36,11 +36,13 @@ def sample_tokens(
     safe_temp = jnp.where(greedy, 1.0, temperature)
     scaled = logits / safe_temp[:, None]
 
-    # candidate window
-    win_logits, win_idx = jax.lax.top_k(scaled, TOPK_WINDOW)  # [S, W]
-    ranks = jnp.arange(TOPK_WINDOW)[None, :]
+    # candidate window (static shape; clamped for tiny vocabularies —
+    # lax.top_k rejects k > V)
+    window = min(TOPK_WINDOW, V)
+    win_logits, win_idx = jax.lax.top_k(scaled, window)  # [S, W]
+    ranks = jnp.arange(window)[None, :]
     # top-k mask (0 = off)
-    k = jnp.where(top_k <= 0, TOPK_WINDOW, jnp.minimum(top_k, TOPK_WINDOW))
+    k = jnp.where(top_k <= 0, window, jnp.minimum(top_k, window))
     keep = ranks < k[:, None]
     # top-p mask over the window distribution
     win_probs = jax.nn.softmax(win_logits, axis=-1)

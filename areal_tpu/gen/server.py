@@ -76,7 +76,16 @@ class GenServer:
                             upd["stage_params"], version=upd.get("version")
                         )
                     elif upd.get("commit_staged"):
-                        v = self.engine.commit_staged()
+                        v = self.engine.commit_staged(
+                            live=bool(upd.get("live"))
+                        )
+                    elif upd.get("live") and upd.get("params") is not None:
+                        # live commit without standby HBM: the pause is the
+                        # host->device placement, but in-flight requests
+                        # wait it out instead of dying
+                        v = self.engine.swap_weights_live(
+                            upd["params"], version=upd.get("version")
+                        )
                     else:
                         v = self.engine.load_weights(
                             path=upd.get("path"),
@@ -222,8 +231,13 @@ class GenServer:
                 body.get("version") is None
                 or body["version"] == self.engine.staged_version
             ):
-                # pre-staged: the swap itself runs on the worker thread
-                fut = self._queue_weight_update(commit_staged=True)
+                # pre-staged: the swap itself runs on the worker thread —
+                # which is also the stepper, so `live: true` (no abort,
+                # in-flight requests keep decoding across the swap, versions
+                # recorded per token) is race-free by construction
+                fut = self._queue_weight_update(
+                    commit_staged=True, live=bool(body.get("live"))
+                )
                 version = await asyncio.wrap_future(fut)
                 self._last_committed_version = version
                 return web.json_response({"ok": True, "version": version})
@@ -233,7 +247,10 @@ class GenServer:
             ):
                 params, version = self._unstaged_params
                 self._unstaged_params = None
-                fut = self._queue_weight_update(params=params, version=version)
+                fut = self._queue_weight_update(
+                    params=params, version=version,
+                    live=bool(body.get("live")),
+                )
                 version = await asyncio.wrap_future(fut)
                 self._last_committed_version = version
                 return web.json_response({"ok": True, "version": version})
@@ -253,7 +270,8 @@ class GenServer:
                 )
             params = self._assemble_params()
             fut = self._queue_weight_update(
-                params=params, version=body.get("version")
+                params=params, version=body.get("version"),
+                live=bool(body.get("live")),
             )
             version = await asyncio.wrap_future(fut)
             self._last_committed_version = version

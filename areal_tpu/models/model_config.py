@@ -100,6 +100,10 @@ class TransformerConfig:
     # scan overhead (dynamic-update-slice carry traffic); must divide
     # num_layers to take effect
     scan_unroll: int = 1
+    # lax.scan(_split_transpose=...): split the backward (transposed) layer
+    # scan into two passes — XLA can then overlap the grad-accumulation
+    # carry writes differently; measured per-hardware, off by default
+    scan_split_transpose: bool = False
     # attention implementation: "auto" picks the Pallas splash kernel on TPU
     # when shapes allow and the naive einsum path elsewhere (ops/attention.py)
     attn_impl: str = "auto"  # auto | splash | naive

@@ -202,6 +202,7 @@ def _backbone(
         (x, jnp.zeros((), jnp.float32)),
         params["layers"],
         unroll=max(1, unroll),
+        _split_transpose=cfg.scan_split_transpose,
     )
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), aux
 

@@ -140,8 +140,20 @@ def _make_kernel(
     """Build (and cache — mask-info preprocessing is host-side numpy) the
     MQA splash kernel for one (seq-len, q-group) shape."""
     mask = _sm.MultiHeadMask([_mask_for(T, sliding_window) for _ in range(group)])
-    # block sizes must divide the per-shard query extent
-    block = min(512, T // q_seq_shards)
+    # block sizes must DIVIDE the per-shard query extent (the kernel
+    # rejects them otherwise): largest 128-multiple <= 512 that divides —
+    # e.g. a 768-token packed row gets 384, not a crashing 512.
+    # splash_supported guarantees ext % 128 == 0, so the search always
+    # terminates at >= 128; assert rather than loop to 0 for direct callers
+    ext = T // q_seq_shards
+    if ext % 128:
+        raise ValueError(
+            f"per-shard query extent {ext} must be a multiple of 128 "
+            "(gate shapes through splash_supported)"
+        )
+    block = min(512, ext)
+    while ext % block:
+        block -= 128
     block_sizes = _sk.BlockSizes(
         block_q=block,
         block_kv=block,

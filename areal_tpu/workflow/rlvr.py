@@ -57,11 +57,21 @@ class RLVRWorkflow(RolloutWorkflow):
         return self.tokenizer.encode(data["prompt"])
 
     def _build_request(self, data: Dict[str, Any]) -> ModelRequest:
-        """Hook: subclasses (vision) add modality payloads to the request."""
+        """Hook: subclasses (vision) add modality payloads to the request.
+
+        A dataset item may carry its own `max_new_tokens` to cap this
+        prompt's generation budget below the workflow default (e.g.
+        per-difficulty budgets, or benchmark workloads with realistic
+        length variance)."""
+        overrides = {"n_samples": 1}
+        if "max_new_tokens" in data:
+            overrides["max_new_tokens"] = min(
+                int(data["max_new_tokens"]), self.gconfig.max_new_tokens
+            )
         return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=self._tokenize_prompt(data),
-            gconfig=self.gconfig.new(n_samples=1),
+            gconfig=self.gconfig.new(**overrides),
             tokenizer=self.tokenizer,
         )
 

@@ -264,6 +264,40 @@ def main():
         except Exception as e:  # noqa: BLE001 — informational extras
             print(f"bench: serving probe failed: {str(e)[:120]}", file=sys.stderr)
 
+    # primary-metric carry-over: the full async-vs-sync e2e loop takes
+    # ~20 min on chip (scripts/bench_e2e_grpo.py), so its latest recorded
+    # run rides along here instead of re-running inside the bench budget
+    try:
+        import glob
+
+        runs = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "E2E_GRPO_BENCH_r*.json")))
+        if runs:
+            with open(runs[-1]) as f:
+                e2e = json.load(f)
+            # prefer the run BASELINE.json.published quotes: the
+            # heterogeneous-length workload (its latest rerun), falling
+            # back to the uniform-length live-swap run
+            het = e2e.get("heterogeneous_length_live_swap", {})
+            live = (
+                het.get("rerun_after_warm_signature_fix")
+                or het
+                or e2e.get("publish_mode_live_swap")
+                or e2e
+            )
+            result["e2e_artifact"] = os.path.basename(runs[-1])
+            result["e2e_async_trajs_per_sec_per_chip"] = (
+                live["async"]["trajs_per_sec_per_chip"])
+            result["e2e_async_over_sync"] = (
+                live["async_over_sync_trajs_per_sec"])
+            result["e2e_publish_pause_s"] = (
+                live["async"].get("pause_window_s_mean")
+                or het.get("async", {}).get("pause_window_s_mean"))
+    except Exception as e:  # noqa: BLE001 — informational extras
+        print(f"bench: e2e carry-over failed: {str(e)[:120]}",
+              file=sys.stderr)
+
     print(json.dumps(result))
 
 

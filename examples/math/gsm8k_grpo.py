@@ -101,7 +101,8 @@ def main(argv):
 
     if config.weight_update_mode == "transfer":
         weight_meta = WeightUpdateMeta.from_transfer(
-            config.experiment_name, config.trial_name
+            config.experiment_name, config.trial_name,
+            live_commit=config.weight_update_live_commit,
         )
     else:
         weight_meta = WeightUpdateMeta.from_disk(
@@ -202,6 +203,11 @@ def main(argv):
         )
         if info is not None:
             start_step = info.recover_start.global_step
+
+    if config.warm_pack_shapes:
+        # AOT-compile the expected pack signatures so the first steps don't
+        # stall on XLA compiles as rollout lengths vary
+        actor.warm_shapes([tuple(s) for s in config.warm_pack_shapes])
 
     total_steps = config.total_train_steps or ft_spec.total_train_steps
     steps_per_epoch = ft_spec.steps_per_epoch

@@ -103,10 +103,14 @@ def main(argv):
     actor = JaxVLMPPOActor(config.actor, model_config=model_config)
     actor.create_process_group()
     actor.initialize(ft_spec=ft_spec)
+    if config.warm_pack_shapes:
+        # fail at startup rather than silently skipping the documented warm
+        actor.warm_shapes([tuple(s) for s in config.warm_pack_shapes])
 
     if config.weight_update_mode == "transfer":
         weight_meta = WeightUpdateMeta.from_transfer(
-            config.experiment_name, config.trial_name
+            config.experiment_name, config.trial_name,
+            live_commit=config.weight_update_live_commit,
         )
     else:
         weight_meta = WeightUpdateMeta.from_disk(

@@ -116,6 +116,35 @@ def _batch_tokens(batch) -> int:
     return int(np.asarray(batch["attention_mask"]).sum())
 
 
+def plan_warm_shapes(args, dataset):
+    """Dry-run the packer over sampled step batches to enumerate the
+    (rows, row_len) signatures the loop will hit, so warm_shapes can
+    AOT-compile them before the timed region (varying rollout lengths
+    otherwise recompile INSIDE the loop — ~30-60 s per signature on a
+    tunneled chip, which sank the first heterogeneous-length run)."""
+    from areal_tpu.utils.data import pack_into_rows
+    from areal_tpu.utils.datapack import round_up_to_bucket
+
+    quantum = 256
+    rng = np.random.default_rng(7)
+    shapes = set()
+    for _ in range(8):
+        idx = rng.choice(len(dataset), args.batch_size, replace=False)
+        lens = []
+        for i in idx:
+            budget = dataset[int(i)].get("max_new_tokens",
+                                         args.max_new_tokens)
+            lens.extend([args.prompt_len + budget] * args.group_size)
+        row_len = round_up_to_bucket(max(lens), quantum, args.max_seq_len)
+        mask = np.zeros((len(lens), max(lens)), bool)
+        for r, n in enumerate(lens):
+            mask[r, :n] = True
+        rp = pack_into_rows({"attention_mask": mask}, row_len,
+                            rows_bucket_pow2=True)
+        shapes.add((rp.n_rows, row_len))
+    return sorted(shapes)
+
+
 def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
              steps: int, warmup: int = 1, interrupt_publish: bool = False):
     """-> {trajs_per_sec, effective_tokens_per_sec, steps, pause_s_mean}"""
@@ -210,6 +239,10 @@ def main():
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--modes", default="sync,async")
+    p.add_argument("--len-jitter", type=float, default=0.0,
+                   help=">0 gives each prompt a log-uniform generation "
+                        "budget in [max_new/(1+j), max_new] — length "
+                        "variance a la real math workloads")
     p.add_argument("--publish-mode", default="live",
                    choices=["live", "interrupt"],
                    help="live = non-aborting swap_weights_live (colocated "
@@ -241,19 +274,42 @@ def main():
         ),
     )
     rng = np.random.default_rng(0)
-    dataset = [
-        {"input_ids": rng.integers(0, cfg.vocab_size,
-                                   args.prompt_len).tolist(),
-         "query_id": str(i)}
-        for i in range(256)
-    ]
+    dataset = []
+    for i in range(256):
+        item = {
+            "input_ids": rng.integers(0, cfg.vocab_size,
+                                      args.prompt_len).tolist(),
+            "query_id": str(i),
+        }
+        if args.len_jitter > 0:
+            # realistic length variance (the reference's math workloads
+            # span 1k-31k generated tokens): log-uniform budgets in
+            # [max_new/(1+j), max_new].  Sync pays the straggler tail every
+            # step; async absorbs it — this is the regime the async design
+            # targets.
+            lo = args.max_new_tokens / (1.0 + args.len_jitter)
+            item["max_new_tokens"] = int(np.exp(
+                rng.uniform(np.log(lo), np.log(args.max_new_tokens))
+            ))
+        dataset.append(item)
+    shapes = plan_warm_shapes(args, dataset)
+    print(f"warming {len(shapes)} pack signatures: {shapes}",
+          file=sys.stderr, flush=True)
+    t_warm = time.perf_counter()
+    actor.warm_shapes(shapes)
+    warm_s = round(time.perf_counter() - t_warm, 1)
+    print(f"warm done in {warm_s}s", file=sys.stderr, flush=True)
+
     result = {
         "model": args.model,
         "device_kind": jax.devices()[0].device_kind,
         "batch_size": args.batch_size,
         "group_size": args.group_size,
         "max_new_tokens": args.max_new_tokens,
+        "len_jitter": args.len_jitter,
         "publish_mode": args.publish_mode,
+        "warm_shapes": [list(s) for s in shapes],
+        "warm_s": warm_s,
     }
     for mode in args.modes.split(","):
         result[mode] = run_mode(

@@ -243,3 +243,88 @@ def test_reward_model_handles_wide_padding():
     mask[:, :10] = True
     stats = rw.train_rw({"input_ids": ids, "attention_mask": mask})
     assert np.isfinite(stats["loss"])
+
+
+def test_warm_shapes_precompiles_without_side_effects():
+    """warm_shapes runs the full logp/advantage/update pipeline for each
+    shape signature, then restores params + optimizer state exactly —
+    production loops call it up front so varying rollout lengths never
+    trigger an XLA compile inside the timed training path."""
+    import jax
+
+    actor = _actor(recompute_logprob=True, use_decoupled_loss=True)
+    p0 = jax.tree_util.tree_map(np.asarray, actor.params)
+    actor.warm_shapes([(8, 16), (8, 32)])
+    # params and optimizer state restored bit-exactly
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p0),
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, actor.params)
+        ),
+    ):
+        np.testing.assert_array_equal(a, b)
+    # a real update afterwards still works and DOES move params
+    rng = np.random.default_rng(5)
+    batch = _rollout_batch(rng)
+    batch["prox_logp"] = actor.compute_logp(batch)
+    actor.compute_advantages(batch)
+    actor.ppo_update(batch)
+    actor.flush_stats()
+    moved = any(
+        not np.array_equal(a, np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p0),
+            jax.tree_util.tree_leaves(actor.params),
+        )
+    )
+    assert moved
+    # group-size divisibility is enforced
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        actor.warm_shapes([(6, 16)])
+
+
+def test_warm_shapes_covers_real_batch_signature():
+    """The program warm_shapes AOT-compiles must be the one the REAL loop
+    requests: a rollout batch carrying extra wire keys (versions, rewards)
+    and int32 loss_mask must present the SAME filtered jit signature as the
+    warm batch (forward() filters to FORWARD_KEYS — regression: the warm
+    compiled a float32-loss_mask/no-extras signature no real call hit)."""
+    actor = _actor(recompute_logprob=True, use_decoupled_loss=True)
+    eng = actor  # JaxPPOActor IS the engine
+
+    def fwd_signature(batch):
+        rp, data, row_len = eng._prepare_rows(dict(batch), 1)
+        view = eng._forward_batch_view(data)
+        return row_len, {
+            k: (v.shape, str(np.asarray(v).dtype)) for k, v in view.items()
+        }
+
+    # the synthetic warm batch for signature (8, 16)
+    rng0 = np.random.default_rng(0)
+    warm_batch = {
+        "input_ids": rng0.integers(0, MODEL_CFG.vocab_size, (8, 16)).astype(
+            np.int32),
+        "attention_mask": np.ones((8, 16), bool),
+        "loss_mask": np.concatenate(
+            [np.zeros((8, 4), np.float32), np.ones((8, 12), np.float32)], 1),
+        "logprobs": np.zeros((8, 16), np.float32),
+        "rewards": np.zeros(8, np.float32),
+    }
+    # a real rollout batch with wire extras + int32 loss_mask
+    real_batch = _rollout_batch(np.random.default_rng(11), B=8, L=16)
+    assert "versions" in real_batch
+    assert fwd_signature(warm_batch) == fwd_signature(real_batch)
+
+    # and end to end: warm, then the real pipeline runs without error and
+    # repeated calls do not grow the forward jit cache
+    actor.warm_shapes([(8, 16)])
+    [fwd] = [f for k, f in actor._forward_cache.items() if k[0] == "fwd"]
+    for seed in (11, 12):
+        b = _rollout_batch(np.random.default_rng(seed), B=8, L=16)
+        b["prox_logp"] = actor.compute_logp(b)
+        actor.compute_advantages(b)
+        actor.ppo_update(b)
+    actor.flush_stats()
+    assert fwd._cache_size() <= 1, "forward retraced across identical shapes"

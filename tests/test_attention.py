@@ -59,6 +59,20 @@ def test_splash_matches_naive_packed_segments():
     assert err < 1e-4
 
 
+def test_splash_non_pow2_extent_picks_dividing_block():
+    """A 768-token packed row is 128-aligned but NOT divisible by the
+    default 512 query block; the kernel builder must step down to 384
+    instead of crashing (regression: heterogeneous-length GRPO rollouts
+    quantized to 768-token rows killed the train step)."""
+    rng = np.random.default_rng(3)
+    q, k, v, seg, pos = _packed_inputs(rng, B=1, T=768, Hq=2, Hkv=1, hd=128)
+    out = segment_attention(q, k, v, seg, pos, impl="splash")
+    ref = _naive(q, k, v, seg, pos)
+    valid = np.asarray(seg) >= 0
+    err = np.abs(np.asarray(out) - np.asarray(ref))[valid].max()
+    assert err < 1e-4
+
+
 def test_splash_sliding_window():
     rng = np.random.default_rng(1)
     q, k, v, seg, pos = _packed_inputs(rng, B=1, T=256, Hq=2, Hkv=1, hd=128, n_segs=2)

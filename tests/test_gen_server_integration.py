@@ -222,3 +222,91 @@ def test_staged_transfer_commit_is_pointer_swap(live_server):
 
     # prepare without chunks is a clean 409
     post("/update_weights_chunk", {"prepare": True}, expect=409)
+
+
+def test_live_commit_keeps_inflight_request_decoding(live_server):
+    """`commit` with `live: true` swaps staged weights WITHOUT aborting:
+    an in-flight request survives the publish and its per-token versions
+    record the policy transition (the wire-level counterpart of
+    GenEngine.swap_weights_live; WeightUpdateMeta.live_commit sends this)."""
+    import json
+    import threading as _threading
+    import urllib.request
+
+    import jax
+    import ml_dtypes
+
+    from areal_tpu.models.hf import params_to_hf_state
+
+    engine, addr = live_server
+    v0 = engine.version
+
+    def post(ep, payload=None, data=None, headers=None):
+        if data is not None:
+            req = urllib.request.Request(
+                f"http://{addr}{ep}", data=data,
+                headers={"Content-Type": "application/octet-stream",
+                         **(headers or {})},
+            )
+        else:
+            req = urllib.request.Request(
+                f"http://{addr}{ep}", data=json.dumps(payload or {}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    # long-budget request in flight on a background thread
+    box = {}
+
+    def _gen():
+        box["resp"] = post("/generate", {
+            "rid": "live", "input_ids": [11, 12, 13],
+            "sampling_params": {"max_new_tokens": 60, "temperature": 0.0},
+        })
+
+    t = _threading.Thread(target=_gen)
+    t.start()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        reqs = [r for r in engine.slot_req if r is not None]
+        if reqs and len(reqs[0].output_tokens) >= 3:
+            break
+        time.sleep(0.005)
+    else:
+        pytest.fail("request never started decoding")
+    # park decoding deterministically while we stage + commit
+    post("/pause_generation")
+
+    new_params = init_params(CFG, jax.random.PRNGKey(321))
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    for name, arr in params_to_hf_state(
+        jax.tree_util.tree_map(np.asarray, new_params), CFG
+    ):
+        raw = np.ascontiguousarray(arr.astype(bf16)).tobytes()
+        post("/update_weights_chunk", data=raw, headers={
+            "X-Weight-Name": name,
+            "X-Weight-Dtype": "bfloat16",
+            "X-Weight-Shape": json.dumps(list(arr.shape)),
+            "X-Weight-Nbytes": str(len(raw)),
+            "X-Weight-Offset": "0",
+        })
+    v1 = v0 + 3
+    out = post("/update_weights_chunk", {"prepare": True, "version": v1})
+    assert out["staged"] is True
+    out = post("/update_weights_chunk",
+               {"commit": True, "version": v1, "live": True})
+    assert out["version"] == v1
+    # the in-flight request was NOT aborted by the live commit
+    assert "resp" not in box or box["resp"]["stop_reason"] != "abort"
+    post("/continue_generation")
+
+    t.join(timeout=60)
+    assert not t.is_alive()
+    resp = box["resp"]
+    assert resp["stop_reason"] == "length"
+    assert len(resp["output_tokens"]) == 60
+    # tokens straddle the publish: old version before, new after
+    assert resp["output_versions"][0] == v0
+    assert resp["output_versions"][-1] == v1
+    assert set(resp["output_versions"]) == {v0, v1}

@@ -333,3 +333,28 @@ def test_grpo_loss_accepts_lm_output():
     np.testing.assert_allclose(
         float(stats_c["entropy"]), float(stats_d["entropy"]), rtol=1e-5
     )
+
+
+def test_sampling_vocab_smaller_than_window():
+    """lax.top_k rejects k > V: a vocabulary smaller than TOPK_WINDOW (64)
+    must clamp the candidate window instead of crashing (regression found
+    driving the gen server with a 61-token tiny model)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.gen.sampling import sample_tokens
+
+    S, V = 4, 61
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(0, 1.0, (S, V)).astype(np.float32))
+    toks, lps = sample_tokens(
+        logits,
+        jax.random.PRNGKey(0),
+        temperature=jnp.array([0.0, 1.0, 1.0, 1.0]),
+        top_k=jnp.array([0, 0, 5, 0], jnp.int32),
+        top_p=jnp.array([1.0, 1.0, 1.0, 0.9]),
+    )
+    toks = np.asarray(toks)
+    assert toks.shape == (S,) and (0 <= toks).all() and (toks < V).all()
+    assert toks[0] == int(np.argmax(np.asarray(logits)[0]))  # greedy slot
+    assert np.all(np.isfinite(np.asarray(lps)))
