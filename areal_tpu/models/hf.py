@@ -45,6 +45,19 @@ _LAYER_MAP = {
     "post_attention_layernorm.weight": (("post_attn_norm",), False),
 }
 
+
+def layer_name_map(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[str, ...], bool]]:
+    """Per-layer HF-name map for a config.  The gemma2 sandwich layout
+    renames the norms: its post_attention_layernorm normalises the attention
+    OUTPUT (our sandwich_attn_norm) while pre_feedforward_layernorm is the
+    pre-FFN norm every other family calls post_attention_layernorm."""
+    m = dict(_LAYER_MAP)
+    if cfg.sandwich_norms:
+        m["post_attention_layernorm.weight"] = (("sandwich_attn_norm",), False)
+        m["pre_feedforward_layernorm.weight"] = (("post_attn_norm",), False)
+        m["post_feedforward_layernorm.weight"] = (("sandwich_ffn_norm",), False)
+    return m
+
 # vision tower (models/vision.py tree) <-> "visual."-prefixed names in the
 # REAL Qwen2.5-VL checkpoint convention (RMSNorm norm1/norm2, biased
 # qkv/proj + gated mlp, merger.ln_q + merger.mlp.{0,2}); weights store
@@ -139,6 +152,7 @@ def state_to_params(
     streamed weight-update path (gen/server.py /update_weights_chunk)."""
     L = cfg.num_layers
     np_dtype = np.dtype(dtype)
+    lmap = layer_name_map(cfg)
     params: Dict[str, Any] = {"layers": {}}
     fill_count: Dict[Tuple[str, ...], int] = {}
     # expected writes per path: L for dense leaves, L*E for expert stacks
@@ -205,8 +219,8 @@ def state_to_params(
         m = _LAYER_RE.match(name)
         if m:
             idx, suffix = int(m.group(1)), m.group(2)
-            if suffix in _LAYER_MAP:
-                path_in_layer, transpose = _LAYER_MAP[suffix]
+            if suffix in lmap:
+                path_in_layer, transpose = lmap[suffix]
                 if transpose:
                     arr = arr.T
                 buf = layer_buf(path_in_layer, arr.shape)
@@ -310,9 +324,10 @@ def params_to_hf_state(
         if mixtral
         else {"w_gate": "gate_proj", "w_up": "up_proj", "w_down": "down_proj"}
     )
+    lmap = layer_name_map(cfg)
     for i in range(cfg.num_layers):
         prefix = f"model.layers.{i}."
-        for suffix, (path_in_layer, transpose) in _LAYER_MAP.items():
+        for suffix, (path_in_layer, transpose) in lmap.items():
             try:
                 buf = _get_nested(layers, path_in_layer)
             except KeyError:

@@ -68,6 +68,19 @@ class TransformerConfig:
     qk_norm: bool = False  # qwen3
     attn_logit_softcap: Optional[float] = None  # gemma2
     sliding_window: Optional[int] = None
+    # per-layer attention kinds (gemma2/3 alternate sliding/full): tuple of
+    # bools, True = this layer uses the sliding window.  None = uniform
+    # (every layer slides iff sliding_window is set, the mistral behavior).
+    layer_is_sliding: Optional[tuple] = None
+
+    # gemma-family structure knobs (reference keeps a gemma converter,
+    # realhf/api/from_hf/gemma.py; defaults reproduce the llama family)
+    hidden_act: str = "silu"  # silu | gelu_pytorch_tanh | gelu
+    scale_embeddings: bool = False  # multiply embeds by sqrt(hidden_size)
+    norm_unit_offset: bool = False  # RMSNorm weight stored zero-centered
+    sandwich_norms: bool = False  # gemma2: extra norms on attn/ffn outputs
+    final_logit_softcap: Optional[float] = None  # gemma2 lm-head tanh cap
+    query_pre_attn_scalar: Optional[float] = None  # softmax scale = qpas^-0.5
 
     # MoE (mixtral / qwen3-moe); num_experts == 0 means dense
     num_experts: int = 0
@@ -161,6 +174,38 @@ class TransformerConfig:
         if model_type in ("qwen3", "qwen3_moe"):
             qkv_bias = bool(d.get("attention_bias", False))
             qk_norm = True
+        gemma = model_type.startswith("gemma")
+        if gemma and model_type not in ("gemma", "gemma2"):
+            # gemma3+ adds qk-norm / local-rope / different layer_types
+            # semantics — loading it with gemma1/2 structure would run but
+            # silently produce wrong logits
+            raise ValueError(
+                f"unsupported gemma variant {model_type!r}: only gemma and "
+                "gemma2 checkpoints are implemented"
+            )
+        num_layers = d["num_hidden_layers"]
+        layer_is_sliding = None
+        sliding_window = (
+            d.get("sliding_window")
+            if d.get("use_sliding_window", model_type == "mistral")
+            else None
+        )
+        if model_type == "gemma2":
+            # alternating local/global attention; HF encodes it as
+            # layer_types, older configs imply sliding on even layers
+            sliding_window = d.get("sliding_window")
+            lt = d.get("layer_types")
+            if lt is not None:
+                layer_is_sliding = tuple(t == "sliding_attention" for t in lt)
+            else:
+                layer_is_sliding = tuple(
+                    i % 2 == 0 for i in range(num_layers)
+                )
+            if sliding_window is None or not any(layer_is_sliding):
+                # no layer actually slides: drop the window entirely so the
+                # uniform-window (mistral) path can't window every layer
+                layer_is_sliding = None
+                sliding_window = None
         num_heads = d["num_attention_heads"]
         n_experts = d.get("num_local_experts", d.get("num_experts", 0)) or 0
         if (
@@ -186,19 +231,40 @@ class TransformerConfig:
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
             intermediate_size=d.get("intermediate_size", 4 * d["hidden_size"]),
-            num_layers=d["num_hidden_layers"],
+            num_layers=num_layers,
             num_heads=num_heads,
             num_kv_heads=d.get("num_key_value_heads", num_heads),
-            head_dim=d.get("head_dim"),
+            head_dim=d.get("head_dim", 256 if gemma else None),
             max_position_embeddings=d.get("max_position_embeddings", 32768),
             rope_theta=float(d.get("rope_theta", 10000.0)),
             rms_norm_eps=float(d.get("rms_norm_eps", 1e-6)),
-            tie_word_embeddings=bool(d.get("tie_word_embeddings", False)),
+            tie_word_embeddings=bool(d.get("tie_word_embeddings", gemma)),
             qkv_bias=qkv_bias,
             qk_norm=qk_norm,
-            sliding_window=(
-                d.get("sliding_window")
-                if d.get("use_sliding_window", model_type == "mistral")
+            sliding_window=sliding_window,
+            layer_is_sliding=layer_is_sliding,
+            hidden_act=(
+                d.get("hidden_activation")
+                or d.get("hidden_act")
+                or ("gelu_pytorch_tanh" if gemma else "silu")
+            ),
+            scale_embeddings=gemma,
+            norm_unit_offset=gemma,
+            sandwich_norms=model_type == "gemma2",
+            final_logit_softcap=(
+                d.get("final_logit_softcapping")
+                if model_type == "gemma2"
+                else None
+            ),
+            attn_logit_softcap=(
+                d.get("attn_logit_softcapping")
+                if model_type == "gemma2"
+                else None
+            ),
+            query_pre_attn_scalar=(
+                float(d["query_pre_attn_scalar"])
+                if d.get("query_pre_attn_scalar") is not None
+                and model_type == "gemma2"
                 else None
             ),
             num_experts=d.get("num_local_experts", d.get("num_experts", 0)) or 0,
@@ -252,6 +318,8 @@ class TransformerConfig:
             "MistralForCausalLM": "mistral",
             "Qwen3MoeForCausalLM": "qwen3_moe",
             "MixtralForCausalLM": "mixtral",
+            "GemmaForCausalLM": "gemma",
+            "Gemma2ForCausalLM": "gemma2",
         }.get(arch, "llama")
         d = {
             "architectures": [arch],
@@ -266,7 +334,7 @@ class TransformerConfig:
             "rope_theta": self.rope_theta,
             "rms_norm_eps": self.rms_norm_eps,
             "tie_word_embeddings": self.tie_word_embeddings,
-            "hidden_act": "silu",
+            "hidden_act": self.hidden_act,
             "torch_dtype": "bfloat16",
             "bos_token_id": self.bos_token_id,
             "eos_token_id": self.eos_token_id,
@@ -275,6 +343,24 @@ class TransformerConfig:
             d["head_dim"] = self.head_dim
         if model_type in ("qwen2", "qwen3", "mistral", "llama", "qwen3_moe"):
             d["attention_bias"] = self.qkv_bias
+        if model_type.startswith("gemma"):
+            # transformers' gemma configs read hidden_activation
+            d["hidden_activation"] = self.hidden_act
+            d["attention_bias"] = self.qkv_bias
+        if model_type == "gemma2":
+            if self.query_pre_attn_scalar is not None:
+                d["query_pre_attn_scalar"] = self.query_pre_attn_scalar
+            if self.attn_logit_softcap is not None:
+                d["attn_logit_softcapping"] = self.attn_logit_softcap
+            if self.final_logit_softcap is not None:
+                d["final_logit_softcapping"] = self.final_logit_softcap
+            if self.sliding_window is not None:
+                d["sliding_window"] = self.sliding_window
+            if self.layer_is_sliding is not None:
+                d["layer_types"] = [
+                    "sliding_attention" if s else "full_attention"
+                    for s in self.layer_is_sliding
+                ]
         if self.num_experts > 0:
             key = "num_local_experts" if model_type == "mixtral" else "num_experts"
             d[key] = self.num_experts
@@ -282,7 +368,7 @@ class TransformerConfig:
             d["norm_topk_prob"] = True  # the routing this repo computes
             if self.moe_intermediate_size is not None:
                 d["moe_intermediate_size"] = self.moe_intermediate_size
-        if self.sliding_window is not None:
+        if self.sliding_window is not None and model_type != "gemma2":
             d["sliding_window"] = self.sliding_window
             d["use_sliding_window"] = True
         if self.vision is not None:

@@ -45,12 +45,61 @@ Params = Dict[str, Any]
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, unit_offset: bool = False
+) -> jax.Array:
+    """`unit_offset` reads the weight as zero-centered (effective scale
+    1 + w) — the gemma-family convention."""
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if unit_offset:
+        w = 1.0 + w
+    return (x * w).astype(dtype)
+
+
+def _norm(cfg: TransformerConfig, x: jax.Array, weight: jax.Array) -> jax.Array:
+    return rms_norm(x, weight, cfg.rms_norm_eps, cfg.norm_unit_offset)
+
+
+def _act(cfg: TransformerConfig):
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu
+    if cfg.hidden_act in ("gelu_pytorch_tanh", "gelu_tanh"):
+        return functools.partial(jax.nn.gelu, approximate=True)
+    if cfg.hidden_act == "gelu":
+        return functools.partial(jax.nn.gelu, approximate=False)
+    raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
+
+
+def _embed(params: Params, cfg: TransformerConfig, ids: jax.Array, dtype):
+    x = jnp.take(params["embedding"].astype(dtype), ids, axis=0)
+    if cfg.scale_embeddings:
+        # gemma multiplies by sqrt(D) rounded in the compute dtype
+        x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
+    return x
+
+
+def _layer_sliding_flags(cfg: TransformerConfig) -> jax.Array:
+    """bool [L]: whether each layer uses the sliding window (gemma2
+    alternation); all-False when windows are uniform/absent."""
+    if cfg.sliding_window is not None and cfg.layer_is_sliding is not None:
+        return jnp.asarray(cfg.layer_is_sliding, bool)
+    return jnp.zeros((cfg.num_layers,), bool)
+
+
+def _head_logits(params: Params, cfg: TransformerConfig, x: jax.Array, dtype):
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embedding"].T
+    eq = "btd,dv->btv" if x.ndim == 3 else "bd,dv->bv"
+    logits = jnp.einsum(eq, x, head.astype(dtype))
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
 
 
 def rope_cos_sin(positions: jax.Array, head_dim: int, theta: float):
@@ -102,7 +151,7 @@ def _layer_forward(
     their own cache through the same _qkv/_ffn primitives)."""
     B, T, _ = x.shape
     dtype = x.dtype
-    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    h = _norm(cfg, x, lp["input_norm"])
     q, k, v = _qkv(cfg, lp, h, dtype)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
@@ -122,9 +171,14 @@ def _layer_forward(
         )
     attn_out = jax.ad_checkpoint.checkpoint_name(attn_out, "attn_out")
     attn_out = attn_out.reshape(B, T, cfg.q_size)
-    x = x + _proj(cfg, lp["attn"], "wo", attn_out, dtype)
-    h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    attn_delta = _proj(cfg, lp["attn"], "wo", attn_out, dtype)
+    if cfg.sandwich_norms:
+        attn_delta = _norm(cfg, attn_delta, lp["sandwich_attn_norm"])
+    x = x + attn_delta
+    h = _norm(cfg, x, lp["post_attn_norm"])
     ffn_out, aux = _ffn(cfg, lp, h, dtype)
+    if cfg.sandwich_norms:
+        ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
     return x + ffn_out, aux
 
 
@@ -148,22 +202,36 @@ def _backbone(
     if inputs_embeds is not None:
         x = inputs_embeds.astype(dtype)
     else:
-        x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+        x = _embed(params, cfg, input_ids, dtype)
     cos, sin = rope if rope is not None else rope_cos_sin(
         positions, cfg.head_dim_, cfg.rope_theta
     )
 
     B, T = input_ids.shape
     sp = mesh.shape["sp"] if mesh is not None else 1
-    use_splash = cfg.attn_impl != "naive" and splash_supported(
-        T, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, sp=sp
+    per_layer_window = (
+        cfg.sliding_window is not None and cfg.layer_is_sliding is not None
     )
-    # the splash path never materialises a mask; naive builds [B,1,T,T] once
-    mask = (
-        None
-        if use_splash
-        else make_attention_mask(segment_ids, positions, cfg.sliding_window)
+    use_splash = (
+        cfg.attn_impl != "naive"
+        and not per_layer_window  # splash masks are static per kernel
+        and splash_supported(
+            T, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, sp=sp
+        )
     )
+    # the splash path never materialises a mask; naive builds [B,1,T,T] once.
+    # With per-layer windows (gemma2) both variants are built once and each
+    # scan step selects by the layer's flag.
+    mask_win = None
+    if per_layer_window:
+        mask = make_attention_mask(segment_ids, positions, None)
+        mask_win = make_attention_mask(
+            segment_ids, positions, cfg.sliding_window
+        )
+    elif use_splash:
+        mask = None
+    else:
+        mask = make_attention_mask(segment_ids, positions, cfg.sliding_window)
 
     layer_fn = functools.partial(_layer_forward, cfg, mesh)
     if cfg.remat:
@@ -191,20 +259,24 @@ def _backbone(
                 "'save_attn', or 'dots'"
             )
 
-    def scan_body(carry, lp):
+    def scan_body(carry, xs):
+        lp, sliding = xs
         x, aux_sum = carry
-        x, aux = layer_fn(lp, x, cos, sin, segment_ids, positions, mask)
+        m = mask
+        if mask_win is not None:
+            m = jnp.where(sliding, mask_win, mask)
+        x, aux = layer_fn(lp, x, cos, sin, segment_ids, positions, m)
         return (x, aux_sum + aux), None
 
     unroll = cfg.scan_unroll if cfg.num_layers % max(cfg.scan_unroll, 1) == 0 else 1
     (x, aux), _ = jax.lax.scan(
         scan_body,
         (x, jnp.zeros((), jnp.float32)),
-        params["layers"],
+        (params["layers"], _layer_sliding_flags(cfg)),
         unroll=max(1, unroll),
         _split_transpose=cfg.scan_split_transpose,
     )
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps), aux
+    return _norm(cfg, x, params["final_norm"]), aux
 
 
 def forward_hidden(
@@ -233,10 +305,7 @@ def forward(
     consumers should upcast)."""
     dtype = jnp.dtype(cfg.dtype)
     x = forward_hidden(params, cfg, input_ids, positions, segment_ids, mesh=mesh)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embedding"].T
-    return jnp.einsum("btd,dv->btv", x, head.astype(dtype))
+    return _head_logits(params, cfg, x, dtype)
 
 
 class LMOutput(NamedTuple):
@@ -254,6 +323,9 @@ class LMOutput(NamedTuple):
     hidden: jax.Array  # [B, T, D] in compute dtype
     head: jax.Array  # [D, V] in compute dtype
     aux_loss: Optional[jax.Array] = None  # scalar fp32
+    # gemma2 final-logit tanh cap; consumers (ops.functional) must apply it
+    # to every logits chunk.  Static python float, never a traced leaf.
+    logit_softcap: Optional[float] = None
 
 
 def forward_lm(
@@ -276,6 +348,7 @@ def forward_lm(
         hidden=x,
         head=head.astype(dtype),
         aux_loss=aux * cfg.moe_aux_coef if cfg.num_experts > 0 else None,
+        logit_softcap=cfg.final_logit_softcap,
     )
 
 
@@ -325,20 +398,27 @@ def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
     k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
     if cfg.qk_norm:
-        q = rms_norm(q, lp["attn"]["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, lp["attn"]["k_norm"], cfg.rms_norm_eps)
+        q = _norm(cfg, q, lp["attn"]["q_norm"])
+        k = _norm(cfg, k, lp["attn"]["k_norm"])
+    if cfg.query_pre_attn_scalar is not None:
+        # attention kernels scale scores by head_dim^-0.5; pre-scaling q
+        # makes the net softmax scale query_pre_attn_scalar^-0.5 (gemma2)
+        q = q * jnp.asarray(
+            cfg.head_dim_**0.5 / cfg.query_pre_attn_scalar**0.5, q.dtype
+        )
     return q, k, v
 
 
 def _mlp(lp: Params, h: jax.Array, dtype, cfg: Optional[TransformerConfig] = None):
+    act = jax.nn.silu if cfg is None else _act(cfg)
     if cfg is not None and cfg.lora_rank:
         gate = _proj(cfg, lp["mlp"], "w_gate", h, dtype)
         up = _proj(cfg, lp["mlp"], "w_up", h, dtype)
-        return _proj(cfg, lp["mlp"], "w_down", jax.nn.silu(gate) * up, dtype)
+        return _proj(cfg, lp["mlp"], "w_down", act(gate) * up, dtype)
     gate = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_gate"].astype(dtype))
     up = jnp.einsum("btd,df->btf", h, lp["mlp"]["w_up"].astype(dtype))
     return jnp.einsum(
-        "btf,fd->btd", jax.nn.silu(gate) * up, lp["mlp"]["w_down"].astype(dtype)
+        "btf,fd->btd", act(gate) * up, lp["mlp"]["w_down"].astype(dtype)
     )
 
 
@@ -370,7 +450,17 @@ def forward_prefill(
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (S, P))
     valid = positions < prompt_lens[:, None]
     seg = jnp.where(valid, 0, -1)
-    mask = make_attention_mask(seg, positions, cfg.sliding_window)
+    per_layer_window = (
+        cfg.sliding_window is not None and cfg.layer_is_sliding is not None
+    )
+    mask = make_attention_mask(
+        seg, positions, None if per_layer_window else cfg.sliding_window
+    )
+    mask_win = (
+        make_attention_mask(seg, positions, cfg.sliding_window)
+        if per_layer_window
+        else None
+    )
     if rope is not None:
         cos, sin = rope
     else:
@@ -378,33 +468,39 @@ def forward_prefill(
     if inputs_embeds is not None:
         x = inputs_embeds.astype(dtype)
     else:
-        x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+        x = _embed(params, cfg, input_ids, dtype)
 
     def layer(x, xs):
-        lp, ck, cv = xs  # ck/cv: [S_total, M, Hkv, hd] for this layer
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        lp, sliding, ck, cv = xs  # ck/cv: [S_total, M, Hkv, hd] per layer
+        m = mask if mask_win is None else jnp.where(sliding, mask_win, mask)
+        h = _norm(cfg, x, lp["input_norm"])
         q, k, v = _qkv(cfg, lp, h, dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         ck = ck.at[slot_ids, :P].set(k.astype(ck.dtype))
         cv = cv.at[slot_ids, :P].set(v.astype(cv.dtype))
-        attn = attention(q, k, v, mask, cfg.attn_logit_softcap)
-        x = x + _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
-        h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(cfg, lp, h, dtype)[0]
+        attn = attention(q, k, v, m, cfg.attn_logit_softcap)
+        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
+        if cfg.sandwich_norms:
+            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+        x = x + delta
+        h = _norm(cfg, x, lp["post_attn_norm"])
+        ffn_out = _ffn(cfg, lp, h, dtype)[0]
+        if cfg.sandwich_norms:
+            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+        x = x + ffn_out
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+        layer,
+        x,
+        (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     # logits only at each row's final real token
     idx = jnp.maximum(prompt_lens - 1, 0)
     last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embedding"].T
-    logits = jnp.einsum("bd,dv->bv", last, head.astype(dtype))
+    logits = _head_logits(params, cfg, last, dtype)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -432,19 +528,28 @@ def forward_prefill_cached(
     offs = jnp.arange(P, dtype=jnp.int32)
     positions = starts[:, None] + offs[None, :]  # [S, P] global positions
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    x = jnp.take(params["embedding"].astype(dtype), input_ids, axis=0)
+    x = _embed(params, cfg, input_ids, dtype)
     key_pos = jnp.arange(M, dtype=jnp.int32)
     # q at global position g attends cache positions <= g; padding rows
     # (offs >= suffix_lens) produce garbage that is never read
+    per_layer_window = (
+        cfg.sliding_window is not None and cfg.layer_is_sliding is not None
+    )
     mask = (key_pos[None, None, :] <= positions[:, :, None])[:, None]  # [S,1,P,M]
+    mask_win = None
     if cfg.sliding_window is not None:
-        mask &= (
+        win = mask & (
             key_pos[None, None, :] > positions[:, :, None] - cfg.sliding_window
         )[:, None]
+        if per_layer_window:
+            mask_win = win
+        else:
+            mask = win
 
     def layer(x, xs):
-        lp, ck, cv = xs  # [S_total, M, Hkv, hd]
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        lp, sliding, ck, cv = xs  # [S_total, M, Hkv, hd]
+        m = mask if mask_win is None else jnp.where(sliding, mask_win, mask)
+        h = _norm(cfg, x, lp["input_norm"])
         q, k, v = _qkv(cfg, lp, h, dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -452,22 +557,27 @@ def forward_prefill_cached(
         cv = cv.at[slot_ids[:, None], positions].set(v.astype(cv.dtype))
         ckr = jnp.take(ck, slot_ids, axis=0).astype(dtype)  # [S, M, Hkv, hd]
         cvr = jnp.take(cv, slot_ids, axis=0).astype(dtype)
-        attn = attention(q, ckr, cvr, mask, cfg.attn_logit_softcap)
-        x = x + _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
-        h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(cfg, lp, h, dtype)[0]
+        attn = attention(q, ckr, cvr, m, cfg.attn_logit_softcap)
+        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
+        if cfg.sandwich_norms:
+            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+        x = x + delta
+        h = _norm(cfg, x, lp["post_attn_norm"])
+        ffn_out = _ffn(cfg, lp, h, dtype)[0]
+        if cfg.sandwich_norms:
+            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+        x = x + ffn_out
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+        layer,
+        x,
+        (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(cfg, x, params["final_norm"])
     idx = jnp.maximum(suffix_lens - 1, 0)
     last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embedding"].T
-    logits = jnp.einsum("bd,dv->bv", last, head.astype(dtype))
+    logits = _head_logits(params, cfg, last, dtype)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -493,21 +603,32 @@ def forward_decode(
     rp = lengths if rope_positions is None else rope_positions
     positions = rp[:, None].astype(jnp.int32)  # [S, 1]
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    x = jnp.take(params["embedding"].astype(dtype), tokens[:, None], axis=0)
+    x = _embed(params, cfg, tokens[:, None], dtype)
     # attend to cache positions 0..lengths (inclusive: self just written)
     key_pos = jnp.arange(M, dtype=jnp.int32)[None, :]
+    per_layer_window = (
+        cfg.sliding_window is not None and cfg.layer_is_sliding is not None
+    )
     attn_mask = (key_pos <= lengths[:, None])[:, None, None, :]  # [S,1,1,M]
+    mask_win = None
     if cfg.sliding_window is not None:
         # window over CACHE indices, not rope positions (they diverge on
         # VLM slots)
-        attn_mask &= (
+        win = attn_mask & (
             key_pos > lengths[:, None] - cfg.sliding_window
         )[:, None, None, :]
+        if per_layer_window:
+            mask_win = win
+        else:
+            attn_mask = win
     slots = jnp.arange(S)
 
     def layer(x, xs):
-        lp, ck, cv = xs
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        lp, sliding, ck, cv = xs
+        m = attn_mask if mask_win is None else jnp.where(
+            sliding, mask_win, attn_mask
+        )
+        h = _norm(cfg, x, lp["input_norm"])
         q, k, v = _qkv(cfg, lp, h, dtype)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -519,21 +640,26 @@ def forward_decode(
         ck = ck.at[slots, widx].set(k[:, 0].astype(ck.dtype))
         cv = cv.at[slots, widx].set(v[:, 0].astype(cv.dtype))
         attn = attention(
-            q, ck.astype(dtype), cv.astype(dtype), attn_mask, cfg.attn_logit_softcap
+            q, ck.astype(dtype), cv.astype(dtype), m, cfg.attn_logit_softcap
         )
-        x = x + _proj(cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype)
-        h = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _ffn(cfg, lp, h, dtype)[0]
+        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype)
+        if cfg.sandwich_norms:
+            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+        x = x + delta
+        h = _norm(cfg, x, lp["post_attn_norm"])
+        ffn_out = _ffn(cfg, lp, h, dtype)[0]
+        if cfg.sandwich_norms:
+            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+        x = x + ffn_out
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], cache["k"], cache["v"])
+        layer,
+        x,
+        (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embedding"].T
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], head.astype(dtype))
+    x = _norm(cfg, x, params["final_norm"])
+    logits = _head_logits(params, cfg, x[:, 0], dtype)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -552,6 +678,8 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
     def dense(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(pdt)
 
+    # unit-offset (gemma) norms store zero-centered weights: zeros==identity
+    norm_one = jnp.zeros if cfg.norm_unit_offset else jnp.ones
     layers = {
         "attn": {
             "wq": dense(keys[0], (L, D, Hq), D),
@@ -559,9 +687,12 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
             "wv": dense(keys[2], (L, D, Hkv), D),
             "wo": dense(keys[3], (L, Hq, D), Hq),
         },
-        "input_norm": jnp.ones((L, D), pdt),
-        "post_attn_norm": jnp.ones((L, D), pdt),
+        "input_norm": norm_one((L, D), pdt),
+        "post_attn_norm": norm_one((L, D), pdt),
     }
+    if cfg.sandwich_norms:
+        layers["sandwich_attn_norm"] = norm_one((L, D), pdt)
+        layers["sandwich_ffn_norm"] = norm_one((L, D), pdt)
     if cfg.num_experts > 0:
         E = cfg.num_experts
         Fm = cfg.moe_intermediate_size or F
@@ -582,12 +713,12 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
         layers["attn"]["bk"] = jnp.zeros((L, Hkv), pdt)
         layers["attn"]["bv"] = jnp.zeros((L, Hkv), pdt)
     if cfg.qk_norm:
-        layers["attn"]["q_norm"] = jnp.ones((L, cfg.head_dim_), pdt)
-        layers["attn"]["k_norm"] = jnp.ones((L, cfg.head_dim_), pdt)
+        layers["attn"]["q_norm"] = norm_one((L, cfg.head_dim_), pdt)
+        layers["attn"]["k_norm"] = norm_one((L, cfg.head_dim_), pdt)
     params: Params = {
         "embedding": dense(keys[7], (V, D), D),
         "layers": layers,
-        "final_norm": jnp.ones((D,), pdt),
+        "final_norm": norm_one((D,), pdt),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(jax.random.fold_in(keys[7], 1), (D, V), D)
@@ -653,14 +784,18 @@ def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
             else:
                 sub[f"{leaf}_lora_a"] = P(None, "fsdp", None)
                 sub[f"{leaf}_lora_b"] = P(None, None, "tp")
+    layer_specs = {
+        "attn": attn,
+        **ffn,
+        "input_norm": P(None, "fsdp"),
+        "post_attn_norm": P(None, "fsdp"),
+    }
+    if cfg.sandwich_norms:
+        layer_specs["sandwich_attn_norm"] = P(None, "fsdp")
+        layer_specs["sandwich_ffn_norm"] = P(None, "fsdp")
     specs: Params = {
         "embedding": P(vocab_axis, "fsdp"),
-        "layers": {
-            "attn": attn,
-            **ffn,
-            "input_norm": P(None, "fsdp"),
-            "post_attn_norm": P(None, "fsdp"),
-        },
+        "layers": layer_specs,
         "final_norm": P("fsdp"),
     }
     if not cfg.tie_word_embeddings:

@@ -130,7 +130,11 @@ def lm_logprobs_entropy(
         import os
 
         impl = os.environ.get("AREAL_LM_HEAD_IMPL", "fused")
-    if impl == "fused" and entropy_clamp == 0:
+    if (
+        impl == "fused"
+        and entropy_clamp == 0
+        and getattr(out, "logit_softcap", None) is None
+    ):
         import os as _os
 
         from areal_tpu.ops.fused_xent import fused_logprobs_entropy
@@ -155,10 +159,17 @@ def lm_logprobs_entropy(
     ls = lab.reshape(N // c, c)
     head = out.head
 
+    cap = getattr(out, "logit_softcap", None)
+
     @jax.checkpoint
     def one_chunk(carry, xs):
         hc, lc = xs
-        logits = (hc @ head).astype(jnp.float32) * inv_t
+        logits = (hc @ head).astype(jnp.float32)
+        if cap:
+            # gemma2 final-logit tanh cap is part of the model's output
+            # distribution, applied before temperature
+            logits = jnp.tanh(logits / cap) * cap
+        logits = logits * inv_t
         logz = jax.nn.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
         if with_entropy:

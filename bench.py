@@ -291,9 +291,10 @@ def main():
                 live["async"]["trajs_per_sec_per_chip"])
             result["e2e_async_over_sync"] = (
                 live["async_over_sync_trajs_per_sec"])
-            result["e2e_publish_pause_s"] = (
-                live["async"].get("pause_window_s_mean")
-                or het.get("async", {}).get("pause_window_s_mean"))
+            pause = live["async"].get("pause_window_s_mean")
+            if pause is None:  # 0.0 is a real (sub-ms) measurement
+                pause = het.get("async", {}).get("pause_window_s_mean")
+            result["e2e_publish_pause_s"] = pause
     except Exception as e:  # noqa: BLE001 — informational extras
         print(f"bench: e2e carry-over failed: {str(e)[:120]}",
               file=sys.stderr)

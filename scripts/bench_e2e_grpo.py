@@ -116,16 +116,24 @@ def _batch_tokens(batch) -> int:
     return int(np.asarray(batch["attention_mask"]).sum())
 
 
-def plan_warm_shapes(args, dataset):
+def plan_warm_shapes(args, dataset, actor):
     """Dry-run the packer over sampled step batches to enumerate the
     (rows, row_len) signatures the loop will hit, so warm_shapes can
     AOT-compile them before the timed region (varying rollout lengths
     otherwise recompile INSIDE the loop — ~30-60 s per signature on a
-    tunneled chip, which sank the first heterogeneous-length run)."""
+    tunneled chip, which sank the first heterogeneous-length run).
+
+    The packing parameters (quantum, max length, rows multiple) are DERIVED
+    from the live actor so the planned signatures match what
+    `_prepare_rows` (engine/jax_train.py) actually compiles."""
     from areal_tpu.utils.data import pack_into_rows
     from areal_tpu.utils.datapack import round_up_to_bucket
 
-    quantum = 256
+    quantum = actor.config.pack_length_quantum
+    max_len = actor.config.max_pack_length
+    dp = (actor.mesh.shape["dp"] * actor.mesh.shape["fsdp"]
+          * actor.mesh.shape.get("ep", 1))
+    rows_multiple = actor.config.mb_spec.n_mbs * dp
     rng = np.random.default_rng(7)
     shapes = set()
     for _ in range(8):
@@ -135,11 +143,12 @@ def plan_warm_shapes(args, dataset):
             budget = dataset[int(i)].get("max_new_tokens",
                                          args.max_new_tokens)
             lens.extend([args.prompt_len + budget] * args.group_size)
-        row_len = round_up_to_bucket(max(lens), quantum, args.max_seq_len)
+        row_len = round_up_to_bucket(max(lens), quantum, max_len)
         mask = np.zeros((len(lens), max(lens)), bool)
         for r, n in enumerate(lens):
             mask[r, :n] = True
         rp = pack_into_rows({"attention_mask": mask}, row_len,
+                            rows_multiple=rows_multiple,
                             rows_bucket_pow2=True)
         shapes.add((rp.n_rows, row_len))
     return sorted(shapes)
@@ -292,7 +301,7 @@ def main():
                 rng.uniform(np.log(lo), np.log(args.max_new_tokens))
             ))
         dataset.append(item)
-    shapes = plan_warm_shapes(args, dataset)
+    shapes = plan_warm_shapes(args, dataset, actor)
     print(f"warming {len(shapes)} pack signatures: {shapes}",
           file=sys.stderr, flush=True)
     t_warm = time.perf_counter()

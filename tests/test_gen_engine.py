@@ -52,6 +52,39 @@ def test_greedy_matches_full_forward(setup):
     assert len(req.output_versions) == 12
 
 
+def test_gemma2_greedy_matches_full_forward():
+    """The serving paths (bucketed prefill + fused decode) agree with the
+    cache-free forward for the gemma2 structure: sandwich norms, alternating
+    sliding/full layers, logit softcaps, scaled embeddings."""
+    import jax
+
+    cfg = tiny_config(
+        vocab_size=97,
+        num_layers=2,
+        eos_token_id=None,
+        hf_architecture="Gemma2ForCausalLM",
+        hidden_act="gelu_pytorch_tanh",
+        scale_embeddings=True,
+        norm_unit_offset=True,
+        sandwich_norms=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_pre_attn_scalar=8.0,
+        sliding_window=8,
+        layer_is_sliding=(True, False),
+    )
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    engine = GenEngine(cfg, params=params, n_slots=2, max_seq_len=64,
+                       prompt_bucket=16)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 97, 11).tolist()
+    ref = _greedy_reference(cfg, params, prompt, 10)
+    req = GenRequest(rid="g", input_ids=prompt, max_new_tokens=10,
+                     temperature=0.0)
+    engine.generate_blocking([req])
+    assert req.output_tokens == ref
+
+
 def test_concurrent_slots_independent(setup):
     """Interleaved decoding must equal solo decoding for each request."""
     cfg, params, engine = setup

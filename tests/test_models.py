@@ -38,6 +38,23 @@ def _hf_tiny(arch: str, tmp_path, tie=False):
     elif arch == "llama":
         hf_cfg = transformers.LlamaConfig(**common)
         model = transformers.LlamaForCausalLM(hf_cfg)
+    elif arch == "gemma":
+        hf_cfg = transformers.GemmaConfig(**common, head_dim=16)
+        model = transformers.GemmaForCausalLM(hf_cfg)
+    elif arch == "gemma2":
+        # small sliding window so a 17-token input exercises the
+        # alternating local/global layers; eager attn so torch actually
+        # applies the logit softcaps (sdpa drops them)
+        hf_cfg = transformers.Gemma2Config(
+            **common,
+            head_dim=16,
+            query_pre_attn_scalar=16.0,
+            attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0,
+            sliding_window=8,
+            attn_implementation="eager",
+        )
+        model = transformers.Gemma2ForCausalLM(hf_cfg)
     else:
         raise ValueError(arch)
     model = model.eval().to(torch.float32)
@@ -46,7 +63,7 @@ def _hf_tiny(arch: str, tmp_path, tie=False):
     return model, str(out_dir)
 
 
-@pytest.mark.parametrize("arch", ["qwen2", "llama", "qwen3"])
+@pytest.mark.parametrize("arch", ["qwen2", "llama", "qwen3", "gemma", "gemma2"])
 def test_hf_parity(arch, tmp_path):
     import torch
 
@@ -126,6 +143,41 @@ def test_sequences_independent_in_pack():
     both = run_packed([a, b], 16)
     alone = run_packed([a], 16)
     np.testing.assert_allclose(both[: len(a)], alone[: len(a)], rtol=1e-5, atol=1e-5)
+
+
+def test_gemma2_roundtrip_and_transformers_reload(tmp_path):
+    """gemma2's renamed sandwich norms + softcap fields survive
+    save -> transformers reload with identical logits."""
+    import torch
+    import transformers
+
+    model, ckpt = _hf_tiny("gemma2", tmp_path)
+    params, cfg = load_hf_params(ckpt)
+    cfg = cfg.replace(dtype="float32", remat=False)
+
+    rt = tmp_path / "rt"
+    save_hf_checkpoint(params, cfg, str(rt), save_dtype="float32")
+    with open(rt / "config.json") as f:
+        d = json.load(f)
+    assert d["model_type"] == "gemma2"
+    assert d["layer_types"] == ["sliding_attention", "full_attention"]
+
+    reloaded = (
+        transformers.Gemma2ForCausalLM.from_pretrained(
+            str(rt), attn_implementation="eager"
+        )
+        .eval()
+        .to(torch.float32)
+    )
+    rng = np.random.default_rng(5)
+    B, L = 2, 17
+    ids = rng.integers(0, cfg.vocab_size, (B, L)).astype(np.int32)
+    with torch.no_grad():
+        ref = reloaded(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
+    seg = np.broadcast_to(np.arange(B, dtype=np.int32)[:, None], (B, L))
+    got = np.asarray(forward(params, cfg, ids, pos, seg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
 def test_save_roundtrip_and_transformers_reload(tmp_path):
