@@ -295,6 +295,12 @@ def main():
             if pause is None:  # 0.0 is a real (sub-ms) measurement
                 pause = het.get("async", {}).get("pause_window_s_mean")
             result["e2e_publish_pause_s"] = pause
+            mt = e2e.get("multi_turn_agentic")
+            if mt:
+                result["e2e_multiturn_async_over_sync"] = (
+                    mt["async_over_sync_trajs_per_sec"])
+                result["e2e_multiturn_kv_reused_fraction"] = (
+                    mt["kv_reuse"]["reused_fraction"])
     except Exception as e:  # noqa: BLE001 — informational extras
         print(f"bench: e2e carry-over failed: {str(e)[:120]}",
               file=sys.stderr)

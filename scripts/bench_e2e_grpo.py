@@ -40,8 +40,29 @@ def _reward_any_even(prompt, completions, prompt_ids, completion_ids, **kw):
     return float(any(t % 2 == 0 for t in completion_ids))
 
 
+def _reward_mt(prompt, completions, prompt_ids, completion_ids, **kw):
+    """Multi-turn grader: ~1/3 of turns "solve" the task, so episodes span
+    1..max_turns turns — the variable-horizon agentic regime (3 of the 5
+    BASELINE.json target configs are multi-turn/agentic)."""
+    return float(sum(completion_ids) % 3 == 0)
+
+
+class _FakeTokenizer:
+    """Just enough surface for MultiTurnWorkflow on synthetic token data."""
+
+    def decode(self, tokens):
+        return " ".join(str(t) for t in tokens)
+
+    def encode(self, text, add_special_tokens=False):
+        return [3] * 6  # fixed-size feedback suffix
+
+    def apply_chat_template(self, messages, add_generation_prompt=True,
+                            tokenize=True):
+        raise NotImplementedError("bench feeds raw input_ids")
+
+
 def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
-                group_size: int):
+                group_size: int, batch_norm: bool = False):
     import jax
 
     from areal_tpu.api.config import (
@@ -80,8 +101,14 @@ def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
             use_decoupled_loss=True,
             recompute_logprob=True,
             async_stats=True,
-            adv_norm=NormConfig(mean_level="group", std_level="group",
-                                group_size=group_size),
+            adv_norm=(
+                # multi-turn episodes yield ONE trajectory each: normalise
+                # over the batch, not fixed-size groups
+                NormConfig(mean_level="batch", std_level="batch")
+                if batch_norm
+                else NormConfig(mean_level="group", std_level="group",
+                                group_size=group_size)
+            ),
         ),
         model_config=cfg.replace(
             dtype="bfloat16" if model_scale == "0p6b" else "float32",
@@ -135,14 +162,21 @@ def plan_warm_shapes(args, dataset, actor):
           * actor.mesh.shape.get("ep", 1))
     rows_multiple = actor.config.mb_spec.n_mbs * dp
     rng = np.random.default_rng(7)
+    fb = len(_FakeTokenizer().encode(""))  # feedback suffix length
     shapes = set()
-    for _ in range(8):
+    for _ in range(8 if args.workflow == "rlvr" else 32):
         idx = rng.choice(len(dataset), args.batch_size, replace=False)
         lens = []
         for i in idx:
-            budget = dataset[int(i)].get("max_new_tokens",
-                                         args.max_new_tokens)
-            lens.extend([args.prompt_len + budget] * args.group_size)
+            if args.workflow == "multi_turn":
+                # one trajectory per episode; length grows per retry turn
+                t = int(rng.integers(1, args.max_turns + 1))
+                lens.append(args.prompt_len + t * args.max_new_tokens
+                            + (t - 1) * fb)
+            else:
+                budget = dataset[int(i)].get("max_new_tokens",
+                                             args.max_new_tokens)
+                lens.extend([args.prompt_len + budget] * args.group_size)
         row_len = round_up_to_bucket(max(lens), quantum, max_len)
         mask = np.zeros((len(lens), max(lens)), bool)
         for r, n in enumerate(lens):
@@ -248,6 +282,11 @@ def main():
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--modes", default="sync,async")
+    p.add_argument("--workflow", default="rlvr",
+                   choices=["rlvr", "multi_turn"],
+                   help="multi_turn = retry-until-correct agentic episodes "
+                        "(variable turn count; exercises KV prefix reuse)")
+    p.add_argument("--max-turns", type=int, default=3)
     p.add_argument("--len-jitter", type=float, default=0.0,
                    help=">0 gives each prompt a log-uniform generation "
                         "budget in [max_new/(1+j), max_new] — length "
@@ -258,6 +297,12 @@ def main():
                         "default); interrupt = abort-and-resume (the remote "
                         "fleet's choreography) for A/B comparison")
     args = p.parse_args()
+    if args.workflow == "multi_turn" and args.len_jitter > 0:
+        # MultiTurnWorkflow generates with its fixed gconfig budget; per-item
+        # budgets would be ignored and the result JSON would claim a
+        # jittered regime that never ran.  Turn variance already provides
+        # the length distribution in this mode.
+        p.error("--len-jitter is not supported with --workflow multi_turn")
 
     import jax
 
@@ -271,17 +316,32 @@ def main():
     from areal_tpu.workflow.rlvr import RLVRWorkflow
 
     actor, serving, cfg = _make_parts(
-        args.model, args.n_slots, args.max_seq_len, args.group_size
+        args.model, args.n_slots, args.max_seq_len, args.group_size,
+        batch_norm=args.workflow == "multi_turn",
     )
     prewarm_reward_pool()
-    workflow = RLVRWorkflow(
-        reward_fn=_reward_any_even,
-        gconfig=GenerationHyperparameters(
-            n_samples=args.group_size,
-            max_new_tokens=args.max_new_tokens,
-            temperature=1.0,
-        ),
-    )
+    if args.workflow == "multi_turn":
+        from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+        workflow = MultiTurnWorkflow(
+            reward_fn=_reward_mt,
+            gconfig=GenerationHyperparameters(
+                n_samples=1,
+                max_new_tokens=args.max_new_tokens,
+                temperature=1.0,
+            ),
+            tokenizer=_FakeTokenizer(),
+            max_turns=args.max_turns,
+        )
+    else:
+        workflow = RLVRWorkflow(
+            reward_fn=_reward_any_even,
+            gconfig=GenerationHyperparameters(
+                n_samples=args.group_size,
+                max_new_tokens=args.max_new_tokens,
+                temperature=1.0,
+            ),
+        )
     rng = np.random.default_rng(0)
     dataset = []
     for i in range(256):
@@ -311,6 +371,7 @@ def main():
 
     result = {
         "model": args.model,
+        "workflow": args.workflow,
         "device_kind": jax.devices()[0].device_kind,
         "batch_size": args.batch_size,
         "group_size": args.group_size,
@@ -330,6 +391,21 @@ def main():
             result["async"]["trajs_per_sec_per_chip"]
             / result["sync"]["trajs_per_sec_per_chip"], 3,
         )
+    if args.workflow == "multi_turn":
+        # later turns re-prefill only the suffix when the engine still holds
+        # the episode's KV prefix (gen/engine.py _best_reuse_slot)
+        st = serving.engine.stats
+        total_prefill = st["prefill_tokens"] + st["suffix_tokens"] + st[
+            "reused_tokens"
+        ]
+        result["kv_reuse"] = {
+            "prefill_tokens": int(st["prefill_tokens"]),
+            "suffix_tokens": int(st["suffix_tokens"]),
+            "reused_tokens": int(st["reused_tokens"]),
+            "reused_fraction": round(
+                st["reused_tokens"] / max(total_prefill, 1), 3
+            ),
+        }
     # the result line must survive teardown hiccups (stale request
     # callbacks etc.) — print FIRST, clean up after
     print(json.dumps(result))
