@@ -139,6 +139,23 @@ class JaxTrainEngine(TrainEngine):
         self.create_process_group()
         self._ft_spec = ft_spec
         cfg = self.config
+        if (
+            self.model_config is not None
+            and self.model_config.pos_emb == "learned"
+            and cfg.max_pack_length > self.model_config.max_position_embeddings
+        ):
+            # jnp.take clamps, so rows packed past the table would silently
+            # train every overflow position on the last embedding.
+            # max_pack_length is a cap (row lengths bucket up to it), so
+            # clamping keeps short batches working; a single sequence longer
+            # than the table still fails loudly in the packer.
+            logger.warning(
+                "clamping max_pack_length %d to the learned position table "
+                "(%d): gpt2-family models cannot extrapolate positions",
+                cfg.max_pack_length,
+                self.model_config.max_position_embeddings,
+            )
+            cfg.max_pack_length = self.model_config.max_position_embeddings
 
         if cfg.path and not cfg.init_from_scratch:
             host_params, mc = load_hf_params(

@@ -172,6 +172,17 @@ class GenEngine:
             )
         self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.n_slots = n_slots
+        if (
+            self.model_config.pos_emb == "learned"
+            and max_seq_len > self.model_config.max_position_embeddings
+        ):
+            # jnp.take clamps out-of-range rows, so positions past the table
+            # would silently reuse the last embedding — fail loudly instead
+            raise ValueError(
+                f"max_seq_len {max_seq_len} exceeds the learned position "
+                f"table ({self.model_config.max_position_embeddings}); "
+                "gpt2-family models cannot extrapolate positions"
+            )
         self.max_seq_len = max_seq_len
         self.prompt_bucket = prompt_bucket
         self.kv_dtype = kv_dtype

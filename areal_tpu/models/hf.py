@@ -50,7 +50,24 @@ def layer_name_map(cfg: TransformerConfig) -> Dict[str, Tuple[Tuple[str, ...], b
     """Per-layer HF-name map for a config.  The gemma2 sandwich layout
     renames the norms: its post_attention_layernorm normalises the attention
     OUTPUT (our sandwich_attn_norm) while pre_feedforward_layernorm is the
-    pre-FFN norm every other family calls post_attention_layernorm."""
+    pre-FFN norm every other family calls post_attention_layernorm.
+
+    gpt2 is its own dialect: Conv1D weights already store [in, out] (no
+    transpose), LayerNorms carry biases, the MLP is non-gated, and the
+    fused attn.c_attn qkv is handled separately in state_to_params."""
+    if cfg.hf_architecture == "GPT2LMHeadModel":
+        return {
+            "ln_1.weight": (("input_norm",), False),
+            "ln_1.bias": (("input_norm_b",), False),
+            "attn.c_proj.weight": (("attn", "wo"), False),
+            "attn.c_proj.bias": (("attn", "bo"), False),
+            "ln_2.weight": (("post_attn_norm",), False),
+            "ln_2.bias": (("post_attn_norm_b",), False),
+            "mlp.c_fc.weight": (("mlp", "w_up"), False),
+            "mlp.c_fc.bias": (("mlp", "b_up"), False),
+            "mlp.c_proj.weight": (("mlp", "w_down"), False),
+            "mlp.c_proj.bias": (("mlp", "b_down"), False),
+        }
     m = dict(_LAYER_MAP)
     if cfg.sandwich_norms:
         m["post_attention_layernorm.weight"] = (("sandwich_attn_norm",), False)
@@ -178,9 +195,48 @@ def state_to_params(
             _set_nested(vision["layers"], path_in_layer, buf)
             return buf
 
+    gpt2 = cfg.hf_architecture == "GPT2LMHeadModel"
+    D = cfg.hidden_size
     seen_head = False
     for name, arr in items:
         arr = np.asarray(arr)  # bf16 arrives as ml_dtypes.bfloat16; the cast-on-assignment into the stacked buffers handles it
+        if gpt2:
+            # gpt2 dialect: transformer.{wte,wpe,h.N.*,ln_f}; Conv1D
+            # weights are already [in, out]
+            if name.startswith("transformer."):
+                name = name[len("transformer."):]
+            if name.endswith((".attn.bias", ".attn.masked_bias")):
+                continue  # causal-mask buffers, not weights (c_attn.bias
+                # is a real weight and does NOT match the leading dot)
+            if name == "wte.weight":
+                name = "model.embed_tokens.weight"
+            elif name == "wpe.weight":
+                params["pos_embedding"] = arr.astype(np_dtype)
+                continue
+            elif name == "ln_f.weight":
+                name = "model.norm.weight"
+            elif name == "ln_f.bias":
+                params["final_norm_b"] = arr.astype(np_dtype)
+                continue
+            elif name.startswith("h."):
+                name = "model.layers." + name[len("h."):]
+            gm = _LAYER_RE.match(name)
+            if gm and gm.group(2) in ("attn.c_attn.weight", "attn.c_attn.bias"):
+                # fused qkv: split [D, 3D] columns (or [3D] bias) into q/k/v
+                idx = int(gm.group(1))
+                leaves = (
+                    ("attn", "wq"), ("attn", "wk"), ("attn", "wv")
+                ) if gm.group(2).endswith("weight") else (
+                    ("attn", "bq"), ("attn", "bk"), ("attn", "bv")
+                )
+                for j, path_in_layer in enumerate(leaves):
+                    part = arr[..., j * D:(j + 1) * D]
+                    buf = layer_buf(path_in_layer, part.shape)
+                    buf[idx] = part
+                    fill_count[path_in_layer] = (
+                        fill_count.get(path_in_layer, 0) + 1
+                    )
+                continue
         # newer transformers nest the decoder/tower under model.*
         if name.startswith("model.language_model."):
             name = "model." + name[len("model.language_model."):]
@@ -267,9 +323,14 @@ def state_to_params(
                 f"incomplete weights: {'.'.join(path_in_layer)} filled for "
                 f"{n}/{want} slots"
             )
-    for required in ("embedding", "final_norm"):
-        if required not in params:
-            raise ValueError(f"checkpoint missing {required}")
+    required = ["embedding", "final_norm"]
+    if cfg.pos_emb == "learned":
+        required.append("pos_embedding")
+    if cfg.norm_type == "layernorm":
+        required.append("final_norm_b")
+    for req in required:
+        if req not in params:
+            raise ValueError(f"checkpoint missing {req}")
     if cfg.tie_word_embeddings and seen_head:
         del params["lm_head"]
     if not cfg.tie_word_embeddings and not seen_head:
@@ -311,10 +372,37 @@ def load_hf_params(
     return state_to_params(iter_safetensors(path), cfg, dtype), cfg
 
 
+def _gpt2_state(
+    params: Dict[str, Any], cfg: TransformerConfig
+) -> Iterator[Tuple[str, np.ndarray]]:
+    """gpt2-dialect emission: transformer.* names, re-fused c_attn qkv."""
+    pre = "transformer."
+    yield pre + "wte.weight", np.asarray(params["embedding"])
+    yield pre + "wpe.weight", np.asarray(params["pos_embedding"])
+    layers = params["layers"]
+    lmap = layer_name_map(cfg)
+    for i in range(cfg.num_layers):
+        p = f"{pre}h.{i}."
+        attn = layers["attn"]
+        yield p + "attn.c_attn.weight", np.concatenate(
+            [np.asarray(attn[leaf][i]) for leaf in ("wq", "wk", "wv")], axis=1
+        )
+        yield p + "attn.c_attn.bias", np.concatenate(
+            [np.asarray(attn[leaf][i]) for leaf in ("bq", "bk", "bv")]
+        )
+        for suffix, (path_in_layer, _t) in lmap.items():
+            yield p + suffix, np.asarray(_get_nested(layers, path_in_layer)[i])
+    yield pre + "ln_f.weight", np.asarray(params["final_norm"])
+    yield pre + "ln_f.bias", np.asarray(params["final_norm_b"])
+
+
 def params_to_hf_state(
     params: Dict[str, Any], cfg: TransformerConfig
 ) -> Iterator[Tuple[str, np.ndarray]]:
     """Yield HF-named (name, array) pairs from the stacked pytree."""
+    if cfg.hf_architecture == "GPT2LMHeadModel":
+        yield from _gpt2_state(params, cfg)
+        return
     yield "model.embed_tokens.weight", np.asarray(params["embedding"])
     layers = params["layers"]
     mixtral = cfg.hf_architecture == "MixtralForCausalLM"

@@ -4,8 +4,11 @@ One dataclass covers the decoder-only families the reference supports via its
 per-arch HF converters (reference: realhf/api/from_hf/{llama,qwen2,qwen3,
 mistral,gemma,gpt2,mixtral}.py and lite's AutoModelForCausalLM path,
 areal/engine/base_hf_engine.py:46): llama/mistral (no qkv bias, untied),
-qwen2 (qkv bias), qwen3 (qk-norm, explicit head_dim), gemma-style tied
-embeddings.  MoE fields cover the mixtral/qwen3-moe family.
+qwen2 (qkv bias), qwen3 (qk-norm, explicit head_dim), gemma/gemma2 (scaled
+embeddings, zero-centred norms, sandwich norms, logit softcaps, alternating
+sliding/full layers), gpt2 (LayerNorm+bias, learned positions, non-gated
+gelu MLP, fused-qkv checkpoints).  MoE fields cover the mixtral/qwen3-moe
+family.
 
 TPU-first: the config is a frozen, hashable pytree-static object so it can be
 closed over by `jax.jit` without retracing.
@@ -81,6 +84,13 @@ class TransformerConfig:
     sandwich_norms: bool = False  # gemma2: extra norms on attn/ffn outputs
     final_logit_softcap: Optional[float] = None  # gemma2 lm-head tanh cap
     query_pre_attn_scalar: Optional[float] = None  # softmax scale = qpas^-0.5
+
+    # gpt2-family structure knobs (reference: realhf/api/from_hf/gpt2.py)
+    norm_type: str = "rmsnorm"  # rmsnorm | layernorm (mean-centred + bias)
+    pos_emb: str = "rope"  # rope | learned (wpe table added to embeds)
+    mlp_gated: bool = True  # False: w_up -> act -> w_down (no gate branch)
+    attn_output_bias: bool = False  # bias on the attention out-projection
+    mlp_bias: bool = False  # biases on the MLP projections
 
     # MoE (mixtral / qwen3-moe); num_experts == 0 means dense
     num_experts: int = 0
@@ -166,6 +176,37 @@ class TransformerConfig:
         archs = d.get("architectures") or ["LlamaForCausalLM"]
         arch = archs[0]
         model_type = d.get("model_type", "llama")
+        if model_type == "gpt2":
+            # entirely different key names (n_embd/n_layer/...) and block
+            # structure: LayerNorm, learned positions, fused-qkv Conv1D,
+            # non-gated gelu MLP, biases throughout, always-tied head
+            act = d.get("activation_function", "gelu_new")
+            return cls(
+                vocab_size=d["vocab_size"],
+                hidden_size=d["n_embd"],
+                intermediate_size=d.get("n_inner") or 4 * d["n_embd"],
+                num_layers=d["n_layer"],
+                num_heads=d["n_head"],
+                num_kv_heads=d["n_head"],
+                max_position_embeddings=d.get("n_positions", 1024),
+                rms_norm_eps=float(d.get("layer_norm_epsilon", 1e-5)),
+                tie_word_embeddings=True,
+                qkv_bias=True,
+                attn_output_bias=True,
+                mlp_bias=True,
+                mlp_gated=False,
+                norm_type="layernorm",
+                pos_emb="learned",
+                # pass unknown activations through: _act raises loudly for
+                # unsupported ones instead of silently running gelu
+                hidden_act=(
+                    "gelu_pytorch_tanh" if act in ("gelu_new", "gelu_pytorch_tanh")
+                    else act
+                ),
+                hf_architecture=arch,
+                bos_token_id=d.get("bos_token_id", 50256),
+                eos_token_id=d.get("eos_token_id", 50256),
+            )
         qkv_bias = bool(d.get("attention_bias", False))
         qk_norm = False
         if model_type == "qwen2":
@@ -311,6 +352,27 @@ class TransformerConfig:
         """Emit an HF-compatible config dict (for saving checkpoints that
         inference servers / transformers can load back)."""
         arch = self.hf_architecture
+        if arch == "GPT2LMHeadModel":
+            return {
+                "architectures": [arch],
+                "model_type": "gpt2",
+                "vocab_size": self.vocab_size,
+                "n_embd": self.hidden_size,
+                "n_inner": self.intermediate_size,
+                "n_layer": self.num_layers,
+                "n_head": self.num_heads,
+                "n_positions": self.max_position_embeddings,
+                "n_ctx": self.max_position_embeddings,
+                "layer_norm_epsilon": self.rms_norm_eps,
+                "activation_function": (
+                    "gelu_new" if self.hidden_act == "gelu_pytorch_tanh"
+                    else self.hidden_act
+                ),
+                "tie_word_embeddings": True,
+                "torch_dtype": "bfloat16",
+                "bos_token_id": self.bos_token_id,
+                "eos_token_id": self.eos_token_id,
+            }
         model_type = {
             "LlamaForCausalLM": "llama",
             "Qwen2ForCausalLM": "qwen2",

@@ -60,8 +60,28 @@ def rms_norm(
     return (x * w).astype(dtype)
 
 
-def _norm(cfg: TransformerConfig, x: jax.Array, weight: jax.Array) -> jax.Array:
-    return rms_norm(x, weight, cfg.rms_norm_eps, cfg.norm_unit_offset)
+def layer_norm(
+    x: jax.Array, weight: jax.Array, bias: jax.Array, eps: float
+) -> jax.Array:
+    """Mean-centred LayerNorm with bias (gpt2 family), fp32 numerics."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    x = (x - mu) * jax.lax.rsqrt(var + eps)
+    return (
+        x * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    ).astype(dtype)
+
+
+def _norm(
+    cfg: TransformerConfig, x: jax.Array, tree: Params, name: str
+) -> jax.Array:
+    """Normalise with the config's norm flavour; `tree[name]` is the weight,
+    `tree[name + "_b"]` the LayerNorm bias."""
+    if cfg.norm_type == "layernorm":
+        return layer_norm(x, tree[name], tree[name + "_b"], cfg.rms_norm_eps)
+    return rms_norm(x, tree[name], cfg.rms_norm_eps, cfg.norm_unit_offset)
 
 
 def _act(cfg: TransformerConfig):
@@ -74,11 +94,21 @@ def _act(cfg: TransformerConfig):
     raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
 
 
-def _embed(params: Params, cfg: TransformerConfig, ids: jax.Array, dtype):
+def _embed(
+    params: Params,
+    cfg: TransformerConfig,
+    ids: jax.Array,
+    dtype,
+    positions: Optional[jax.Array] = None,
+):
     x = jnp.take(params["embedding"].astype(dtype), ids, axis=0)
     if cfg.scale_embeddings:
         # gemma multiplies by sqrt(D) rounded in the compute dtype
         x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
+    if cfg.pos_emb == "learned":
+        x = x + jnp.take(
+            params["pos_embedding"].astype(dtype), positions, axis=0
+        )
     return x
 
 
@@ -151,10 +181,11 @@ def _layer_forward(
     their own cache through the same _qkv/_ffn primitives)."""
     B, T, _ = x.shape
     dtype = x.dtype
-    h = _norm(cfg, x, lp["input_norm"])
+    h = _norm(cfg, x, lp, "input_norm")
     q, k, v = _qkv(cfg, lp, h, dtype)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if cfg.pos_emb == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     if mask is not None:
         attn_out = attention(q, k, v, mask, cfg.attn_logit_softcap)
     else:
@@ -171,14 +202,14 @@ def _layer_forward(
         )
     attn_out = jax.ad_checkpoint.checkpoint_name(attn_out, "attn_out")
     attn_out = attn_out.reshape(B, T, cfg.q_size)
-    attn_delta = _proj(cfg, lp["attn"], "wo", attn_out, dtype)
+    attn_delta = _proj(cfg, lp["attn"], "wo", attn_out, dtype, bias="bo")
     if cfg.sandwich_norms:
-        attn_delta = _norm(cfg, attn_delta, lp["sandwich_attn_norm"])
+        attn_delta = _norm(cfg, attn_delta, lp, "sandwich_attn_norm")
     x = x + attn_delta
-    h = _norm(cfg, x, lp["post_attn_norm"])
+    h = _norm(cfg, x, lp, "post_attn_norm")
     ffn_out, aux = _ffn(cfg, lp, h, dtype)
     if cfg.sandwich_norms:
-        ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+        ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
     return x + ffn_out, aux
 
 
@@ -202,7 +233,7 @@ def _backbone(
     if inputs_embeds is not None:
         x = inputs_embeds.astype(dtype)
     else:
-        x = _embed(params, cfg, input_ids, dtype)
+        x = _embed(params, cfg, input_ids, dtype, positions=positions)
     cos, sin = rope if rope is not None else rope_cos_sin(
         positions, cfg.head_dim_, cfg.rope_theta
     )
@@ -276,7 +307,7 @@ def _backbone(
         unroll=max(1, unroll),
         _split_transpose=cfg.scan_split_transpose,
     )
-    return _norm(cfg, x, params["final_norm"]), aux
+    return _norm(cfg, x, params, "final_norm"), aux
 
 
 def forward_hidden(
@@ -373,9 +404,18 @@ def forward_packed(params: Params, cfg: TransformerConfig, packed: Dict[str, jax
 # decode advances every slot by exactly one token.
 
 
-def _proj(cfg: TransformerConfig, sub: Params, leaf: str, x: jax.Array, dtype):
-    """x @ W (+ LoRA delta when the leaf is adapted)."""
+def _proj(
+    cfg: TransformerConfig,
+    sub: Params,
+    leaf: str,
+    x: jax.Array,
+    dtype,
+    bias: Optional[str] = None,
+):
+    """x @ W (+ bias leaf if present, + LoRA delta when adapted)."""
     out = jnp.einsum("btd,dh->bth", x, sub[leaf].astype(dtype))
+    if bias is not None and bias in sub:
+        out = out + sub[bias].astype(dtype)
     if cfg.lora_rank:
         from areal_tpu.models.lora import lora_delta, lora_scale
 
@@ -398,8 +438,8 @@ def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
     k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim_)
     if cfg.qk_norm:
-        q = _norm(cfg, q, lp["attn"]["q_norm"])
-        k = _norm(cfg, k, lp["attn"]["k_norm"])
+        q = _norm(cfg, q, lp["attn"], "q_norm")
+        k = _norm(cfg, k, lp["attn"], "k_norm")
     if cfg.query_pre_attn_scalar is not None:
         # attention kernels scale scores by head_dim^-0.5; pre-scaling q
         # makes the net softmax scale query_pre_attn_scalar^-0.5 (gemma2)
@@ -411,6 +451,11 @@ def _qkv(cfg: TransformerConfig, lp: Params, h: jax.Array, dtype):
 
 def _mlp(lp: Params, h: jax.Array, dtype, cfg: Optional[TransformerConfig] = None):
     act = jax.nn.silu if cfg is None else _act(cfg)
+    if cfg is not None and not cfg.mlp_gated:
+        # gpt2-style: up-project, activate, down-project.  _proj applies
+        # bias leaves when present and LoRA deltas when adapted.
+        up = _proj(cfg, lp["mlp"], "w_up", h, dtype, bias="b_up")
+        return _proj(cfg, lp["mlp"], "w_down", act(up), dtype, bias="b_down")
     if cfg is not None and cfg.lora_rank:
         gate = _proj(cfg, lp["mlp"], "w_gate", h, dtype)
         up = _proj(cfg, lp["mlp"], "w_up", h, dtype)
@@ -468,26 +513,30 @@ def forward_prefill(
     if inputs_embeds is not None:
         x = inputs_embeds.astype(dtype)
     else:
-        x = _embed(params, cfg, input_ids, dtype)
+        x = _embed(params, cfg, input_ids, dtype, positions=positions)
 
     def layer(x, xs):
         lp, sliding, ck, cv = xs  # ck/cv: [S_total, M, Hkv, hd] per layer
         m = mask if mask_win is None else jnp.where(sliding, mask_win, mask)
-        h = _norm(cfg, x, lp["input_norm"])
+        h = _norm(cfg, x, lp, "input_norm")
         q, k, v = _qkv(cfg, lp, h, dtype)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         ck = ck.at[slot_ids, :P].set(k.astype(ck.dtype))
         cv = cv.at[slot_ids, :P].set(v.astype(cv.dtype))
         attn = attention(q, k, v, m, cfg.attn_logit_softcap)
-        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
+        delta = _proj(
+            cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype,
+            bias="bo",
+        )
         if cfg.sandwich_norms:
-            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+            delta = _norm(cfg, delta, lp, "sandwich_attn_norm")
         x = x + delta
-        h = _norm(cfg, x, lp["post_attn_norm"])
+        h = _norm(cfg, x, lp, "post_attn_norm")
         ffn_out = _ffn(cfg, lp, h, dtype)[0]
         if cfg.sandwich_norms:
-            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+            ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
         x = x + ffn_out
         return x, (ck, cv)
 
@@ -496,7 +545,7 @@ def forward_prefill(
         x,
         (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params, "final_norm")
     # logits only at each row's final real token
     idx = jnp.maximum(prompt_lens - 1, 0)
     last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
@@ -528,7 +577,7 @@ def forward_prefill_cached(
     offs = jnp.arange(P, dtype=jnp.int32)
     positions = starts[:, None] + offs[None, :]  # [S, P] global positions
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    x = _embed(params, cfg, input_ids, dtype)
+    x = _embed(params, cfg, input_ids, dtype, positions=positions)
     key_pos = jnp.arange(M, dtype=jnp.int32)
     # q at global position g attends cache positions <= g; padding rows
     # (offs >= suffix_lens) produce garbage that is never read
@@ -549,23 +598,27 @@ def forward_prefill_cached(
     def layer(x, xs):
         lp, sliding, ck, cv = xs  # [S_total, M, Hkv, hd]
         m = mask if mask_win is None else jnp.where(sliding, mask_win, mask)
-        h = _norm(cfg, x, lp["input_norm"])
+        h = _norm(cfg, x, lp, "input_norm")
         q, k, v = _qkv(cfg, lp, h, dtype)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         ck = ck.at[slot_ids[:, None], positions].set(k.astype(ck.dtype))
         cv = cv.at[slot_ids[:, None], positions].set(v.astype(cv.dtype))
         ckr = jnp.take(ck, slot_ids, axis=0).astype(dtype)  # [S, M, Hkv, hd]
         cvr = jnp.take(cv, slot_ids, axis=0).astype(dtype)
         attn = attention(q, ckr, cvr, m, cfg.attn_logit_softcap)
-        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype)
+        delta = _proj(
+            cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype,
+            bias="bo",
+        )
         if cfg.sandwich_norms:
-            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+            delta = _norm(cfg, delta, lp, "sandwich_attn_norm")
         x = x + delta
-        h = _norm(cfg, x, lp["post_attn_norm"])
+        h = _norm(cfg, x, lp, "post_attn_norm")
         ffn_out = _ffn(cfg, lp, h, dtype)[0]
         if cfg.sandwich_norms:
-            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+            ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
         x = x + ffn_out
         return x, (ck, cv)
 
@@ -574,7 +627,7 @@ def forward_prefill_cached(
         x,
         (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params, "final_norm")
     idx = jnp.maximum(suffix_lens - 1, 0)
     last = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
     logits = _head_logits(params, cfg, last, dtype)
@@ -603,7 +656,7 @@ def forward_decode(
     rp = lengths if rope_positions is None else rope_positions
     positions = rp[:, None].astype(jnp.int32)  # [S, 1]
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
-    x = _embed(params, cfg, tokens[:, None], dtype)
+    x = _embed(params, cfg, tokens[:, None], dtype, positions=positions)
     # attend to cache positions 0..lengths (inclusive: self just written)
     key_pos = jnp.arange(M, dtype=jnp.int32)[None, :]
     per_layer_window = (
@@ -628,10 +681,11 @@ def forward_decode(
         m = attn_mask if mask_win is None else jnp.where(
             sliding, mask_win, attn_mask
         )
-        h = _norm(cfg, x, lp["input_norm"])
+        h = _norm(cfg, x, lp, "input_norm")
         q, k, v = _qkv(cfg, lp, h, dtype)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         # clamp: a slot past its cache end (freed host-side mid-chunk, still
         # advancing in the fused decode scan) overwrites position M-1 with
         # garbage instead of stalling the whole grid — the engine no longer
@@ -642,14 +696,17 @@ def forward_decode(
         attn = attention(
             q, ck.astype(dtype), cv.astype(dtype), m, cfg.attn_logit_softcap
         )
-        delta = _proj(cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype)
+        delta = _proj(
+            cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype,
+            bias="bo",
+        )
         if cfg.sandwich_norms:
-            delta = _norm(cfg, delta, lp["sandwich_attn_norm"])
+            delta = _norm(cfg, delta, lp, "sandwich_attn_norm")
         x = x + delta
-        h = _norm(cfg, x, lp["post_attn_norm"])
+        h = _norm(cfg, x, lp, "post_attn_norm")
         ffn_out = _ffn(cfg, lp, h, dtype)[0]
         if cfg.sandwich_norms:
-            ffn_out = _norm(cfg, ffn_out, lp["sandwich_ffn_norm"])
+            ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
         x = x + ffn_out
         return x, (ck, cv)
 
@@ -658,7 +715,7 @@ def forward_decode(
         x,
         (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
     )
-    x = _norm(cfg, x, params["final_norm"])
+    x = _norm(cfg, x, params, "final_norm")
     logits = _head_logits(params, cfg, x[:, 0], dtype)
     return logits, {"k": new_k, "v": new_v}
 
@@ -702,12 +759,26 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
             "w_up": dense(keys[5], (L, E, D, Fm), D),
             "w_down": dense(keys[6], (L, E, Fm, D), Fm),
         }
+    elif not cfg.mlp_gated:
+        layers["mlp"] = {
+            "w_up": dense(keys[5], (L, D, F), D),
+            "w_down": dense(keys[6], (L, F, D), F),
+        }
+        if cfg.mlp_bias:
+            layers["mlp"]["b_up"] = jnp.zeros((L, F), pdt)
+            layers["mlp"]["b_down"] = jnp.zeros((L, D), pdt)
     else:
         layers["mlp"] = {
             "w_gate": dense(keys[4], (L, D, F), D),
             "w_up": dense(keys[5], (L, D, F), D),
             "w_down": dense(keys[6], (L, F, D), F),
         }
+    if cfg.attn_output_bias:
+        layers["attn"]["bo"] = jnp.zeros((L, D), pdt)
+    if cfg.norm_type == "layernorm":
+        for nm in list(layers):
+            if nm.endswith("_norm"):
+                layers[nm + "_b"] = jnp.zeros((L, D), pdt)
     if cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, Hq), pdt)
         layers["attn"]["bk"] = jnp.zeros((L, Hkv), pdt)
@@ -720,6 +791,14 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
         "layers": layers,
         "final_norm": norm_one((D,), pdt),
     }
+    if cfg.norm_type == "layernorm":
+        params["final_norm_b"] = jnp.zeros((D,), pdt)
+    if cfg.pos_emb == "learned":
+        params["pos_embedding"] = dense(
+            jax.random.fold_in(keys[7], 2),
+            (cfg.max_position_embeddings, D),
+            D,
+        )
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(jax.random.fold_in(keys[7], 1), (D, V), D)
     return params
@@ -746,6 +825,8 @@ def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
     }
     if cfg.qkv_bias:
         attn.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+    if cfg.attn_output_bias:
+        attn["bo"] = P(None, "fsdp")
     if cfg.qk_norm:
         attn.update(q_norm=P(None, None), k_norm=P(None, None))
     if cfg.num_experts > 0:
@@ -759,6 +840,16 @@ def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
                 "w_down": P(None, "ep", "tp", "fsdp"),
             }
         }
+    elif not cfg.mlp_gated:
+        ffn = {
+            "mlp": {
+                "w_up": P(None, "fsdp", "tp"),
+                "w_down": P(None, "tp", "fsdp"),
+            }
+        }
+        if cfg.mlp_bias:
+            ffn["mlp"]["b_up"] = P(None, "tp")
+            ffn["mlp"]["b_down"] = P(None, "fsdp")
     else:
         ffn = {
             "mlp": {
@@ -793,11 +884,18 @@ def param_partition_specs(cfg: TransformerConfig, tp: int = 0) -> Params:
     if cfg.sandwich_norms:
         layer_specs["sandwich_attn_norm"] = P(None, "fsdp")
         layer_specs["sandwich_ffn_norm"] = P(None, "fsdp")
+    if cfg.norm_type == "layernorm":
+        for nm in [n for n in layer_specs if n.endswith("_norm")]:
+            layer_specs[nm + "_b"] = P(None, "fsdp")
     specs: Params = {
         "embedding": P(vocab_axis, "fsdp"),
         "layers": layer_specs,
         "final_norm": P("fsdp"),
     }
+    if cfg.norm_type == "layernorm":
+        specs["final_norm_b"] = P("fsdp")
+    if cfg.pos_emb == "learned":
+        specs["pos_embedding"] = P(None, "fsdp")
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P("fsdp", vocab_axis)
     return specs

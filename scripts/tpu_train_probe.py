@@ -42,8 +42,14 @@ def make_batch(rng, n_rows, row_len, vocab, seqs_per_row=2):
     }
 
 
-def run(n_rows, row_len, n_mbs, attn_impl="auto"):
-    model_cfg = qwen25_1p5b().replace(attn_impl=attn_impl)
+def run(n_rows, row_len, n_mbs, attn_impl="auto", scan_unroll=4,
+        remat_policy="full", split_transpose=False):
+    # scan_unroll/remat_policy live on the TRAIN config (the engine
+    # overrides model_config with them, jax_train.py:156-161);
+    # split_transpose only exists on the model config
+    model_cfg = qwen25_1p5b().replace(
+        attn_impl=attn_impl, scan_split_transpose=split_transpose
+    )
     cfg = PPOActorConfig(
         experiment_name="bench",
         trial_name="bench",
@@ -51,6 +57,9 @@ def run(n_rows, row_len, n_mbs, attn_impl="auto"):
         dtype="bfloat16",
         param_dtype="bfloat16",
         gradient_checkpointing=True,
+        remat_policy=remat_policy,
+        scan_unroll=scan_unroll,
+        async_stats=True,
         mesh=MeshConfig(),
         mb_spec=MicroBatchSpec(n_mbs=n_mbs),
         optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
@@ -82,21 +91,41 @@ def run(n_rows, row_len, n_mbs, attn_impl="auto"):
     P = 1.54e9
     flops = tokens * 6 * P
     print(
-        f"rows={n_rows} len={row_len} mbs={n_mbs} impl={attn_impl}: "
+        f"rows={n_rows} len={row_len} mbs={n_mbs} impl={attn_impl} "
+        f"unroll={scan_unroll} remat={remat_policy} split={split_transpose}: "
         f"{tps:,.0f} tok/s  step={dt * 1e3:.0f} ms  "
-        f"model-flops {flops / dt / 1e12:.1f} TF/s"
+        f"model-flops {flops / dt / 1e12:.1f} TF/s",
+        flush=True,
     )
     actor.destroy()
     return tps
 
 
 if __name__ == "__main__":
-    for args in [
-        (12, 2048, 1),
-        (16, 2048, 1),
-    ]:
-        try:
-            run(*args)
-        except Exception as e:
-            msg = str(e)
-            print(f"{args}: FAIL {'OOM' if 'RESOURCE_EXHAUSTED' in msg else msg[:200]}")
+    # (n_rows, row_len, n_mbs) + knob overrides; run as
+    #   python scripts/tpu_train_probe.py [sweep]
+    sweep = sys.argv[1:] == ["sweep"]
+    combos = (
+        [  # unroll ladder x remat policy with the fused LM head resident
+            dict(scan_unroll=4, remat_policy="full"),
+            dict(scan_unroll=7, remat_policy="full"),
+            dict(scan_unroll=14, remat_policy="full"),
+            dict(scan_unroll=2, remat_policy="full"),
+            dict(scan_unroll=4, remat_policy="full", split_transpose=True),
+            dict(scan_unroll=4, remat_policy="save_attn"),
+            dict(scan_unroll=7, remat_policy="save_attn"),
+        ]
+        if sweep
+        else [dict()]
+    )
+    for kw in combos:
+        for args in [(8, 2048, 1)] if sweep else [(12, 2048, 1), (16, 2048, 1)]:
+            try:
+                run(*args, **kw)
+            except Exception as e:
+                msg = str(e)
+                print(
+                    f"{args} {kw}: FAIL "
+                    f"{'OOM' if 'RESOURCE_EXHAUSTED' in msg else msg[:200]}",
+                    flush=True,
+                )

@@ -85,6 +85,40 @@ def test_gemma2_greedy_matches_full_forward():
     assert req.output_tokens == ref
 
 
+def test_gpt2_greedy_matches_full_forward():
+    """Serving paths agree with the cache-free forward for the gpt2
+    structure: LayerNorm+bias, learned positions (no rope), fused-qkv
+    checkpoints load into split leaves, non-gated gelu MLP, biases."""
+    import jax
+
+    cfg = tiny_config(
+        vocab_size=97,
+        num_layers=2,
+        eos_token_id=None,
+        hf_architecture="GPT2LMHeadModel",
+        hidden_act="gelu_pytorch_tanh",
+        norm_type="layernorm",
+        pos_emb="learned",
+        mlp_gated=False,
+        qkv_bias=True,
+        attn_output_bias=True,
+        mlp_bias=True,
+        num_kv_heads=4,
+        max_position_embeddings=64,
+        tie_word_embeddings=True,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    engine = GenEngine(cfg, params=params, n_slots=2, max_seq_len=64,
+                       prompt_bucket=16)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 97, 9).tolist()
+    ref = _greedy_reference(cfg, params, prompt, 10)
+    req = GenRequest(rid="p", input_ids=prompt, max_new_tokens=10,
+                     temperature=0.0)
+    engine.generate_blocking([req])
+    assert req.output_tokens == ref
+
+
 def test_concurrent_slots_independent(setup):
     """Interleaved decoding must equal solo decoding for each request."""
     cfg, params, engine = setup

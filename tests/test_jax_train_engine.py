@@ -85,6 +85,44 @@ def test_train_loss_decreases():
     assert stats["lr"] > 0
 
 
+def test_train_loss_decreases_gpt2_and_gemma2():
+    """The trainer's grad path covers the non-llama structures: gpt2
+    (LayerNorm biases, learned positions, non-gated MLP) and gemma2
+    (sandwich norms, softcaps -> chunked head fallback) on a sharded mesh."""
+    for kw in (
+        dict(hf_architecture="GPT2LMHeadModel", norm_type="layernorm",
+             pos_emb="learned", mlp_gated=False, qkv_bias=True,
+             attn_output_bias=True, mlp_bias=True, num_kv_heads=4,
+             hidden_act="gelu_pytorch_tanh", tie_word_embeddings=True),
+        dict(hf_architecture="Gemma2ForCausalLM", sandwich_norms=True,
+             norm_unit_offset=True, scale_embeddings=True,
+             hidden_act="gelu_pytorch_tanh", attn_logit_softcap=50.0,
+             final_logit_softcap=30.0, sliding_window=8,
+             layer_is_sliding=(True, False), tie_word_embeddings=True),
+    ):
+        mc = tiny_config(vocab_size=128, **kw)
+        cfg = TrainEngineConfig(
+            experiment_name="t", trial_name="t", init_from_scratch=True,
+            dtype="float32", gradient_checkpointing=False,
+            mesh=MeshConfig(data_parallel_size=2, fsdp_parallel_size=2,
+                            tensor_parallel_size=2),
+            mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0,
+                                      weight_decay=0.0),
+            pack_length_quantum=16,
+        )
+        eng = JaxTrainEngine(cfg, model_config=mc)
+        eng.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+        rng = np.random.default_rng(2)
+        batch = _batch(rng)
+        losses = [
+            eng.train_batch(batch, sft_loss_fn, _weight)["loss"]
+            for _ in range(8)
+        ]
+        assert losses[-1] < losses[0] * 0.7, (kw["hf_architecture"], losses)
+        eng.destroy()
+
+
 def test_forward_matches_unsharded():
     rng = np.random.default_rng(2)
     batch = _batch(rng)

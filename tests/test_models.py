@@ -41,6 +41,16 @@ def _hf_tiny(arch: str, tmp_path, tie=False):
     elif arch == "gemma":
         hf_cfg = transformers.GemmaConfig(**common, head_dim=16)
         model = transformers.GemmaForCausalLM(hf_cfg)
+    elif arch == "gpt2":
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=256,
+            n_embd=64,
+            n_layer=2,
+            n_head=4,
+            n_positions=256,
+            torch_dtype="float32",
+        )
+        model = transformers.GPT2LMHeadModel(hf_cfg)
     elif arch == "gemma2":
         # small sliding window so a 17-token input exercises the
         # alternating local/global layers; eager attn so torch actually
@@ -63,7 +73,9 @@ def _hf_tiny(arch: str, tmp_path, tie=False):
     return model, str(out_dir)
 
 
-@pytest.mark.parametrize("arch", ["qwen2", "llama", "qwen3", "gemma", "gemma2"])
+@pytest.mark.parametrize(
+    "arch", ["qwen2", "llama", "qwen3", "gemma", "gemma2", "gpt2"]
+)
 def test_hf_parity(arch, tmp_path):
     import torch
 
@@ -170,6 +182,38 @@ def test_gemma2_roundtrip_and_transformers_reload(tmp_path):
         .to(torch.float32)
     )
     rng = np.random.default_rng(5)
+    B, L = 2, 17
+    ids = rng.integers(0, cfg.vocab_size, (B, L)).astype(np.int32)
+    with torch.no_grad():
+        ref = reloaded(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
+    seg = np.broadcast_to(np.arange(B, dtype=np.int32)[:, None], (B, L))
+    got = np.asarray(forward(params, cfg, ids, pos, seg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_roundtrip_and_transformers_reload(tmp_path):
+    """gpt2's dialect (transformer.* names, fused c_attn, Conv1D layout,
+    LayerNorm biases, learned positions) survives save -> transformers
+    reload with identical logits."""
+    import torch
+    import transformers
+
+    model, ckpt = _hf_tiny("gpt2", tmp_path)
+    params, cfg = load_hf_params(ckpt)
+    cfg = cfg.replace(dtype="float32", remat=False)
+    assert cfg.norm_type == "layernorm" and cfg.pos_emb == "learned"
+
+    rt = tmp_path / "rt"
+    save_hf_checkpoint(params, cfg, str(rt), save_dtype="float32")
+    with open(rt / "config.json") as f:
+        assert json.load(f)["model_type"] == "gpt2"
+    reloaded = (
+        transformers.GPT2LMHeadModel.from_pretrained(str(rt))
+        .eval()
+        .to(torch.float32)
+    )
+    rng = np.random.default_rng(6)
     B, L = 2, 17
     ids = rng.integers(0, cfg.vocab_size, (B, L)).astype(np.int32)
     with torch.no_grad():
