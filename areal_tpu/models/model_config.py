@@ -128,8 +128,13 @@ class TransformerConfig:
     # carry writes differently; measured per-hardware, off by default
     scan_split_transpose: bool = False
     # attention implementation: "auto" picks the Pallas splash kernel on TPU
-    # when shapes allow and the naive einsum path elsewhere (ops/attention.py)
-    attn_impl: str = "auto"  # auto | splash | naive
+    # when shapes allow and the naive einsum path elsewhere; "ring" shards
+    # K/V along the sequence over the sp axis with rotating blocks — the
+    # context-parallel regime for contexts too long for per-chip whole-K/V
+    # (ops/attention.py ring_attention; falls back to auto — with a
+    # warning — without an sp>1 mesh axis or with per-layer sliding
+    # windows, which are mask-based)
+    attn_impl: str = "auto"  # auto | splash | naive | ring
 
     # vision-language (None = text-only); Qwen2-VL-style mrope: the rope
     # frequency bands are split into (temporal, height, width) sections

@@ -197,7 +197,7 @@ def _layer_forward(
             pos,
             sliding_window=cfg.sliding_window,
             logit_softcap=cfg.attn_logit_softcap,
-            impl="splash",
+            impl="ring" if cfg.attn_impl == "ring" else "splash",
             mesh=mesh,
         )
     attn_out = jax.ad_checkpoint.checkpoint_name(attn_out, "attn_out")
@@ -243,23 +243,48 @@ def _backbone(
     per_layer_window = (
         cfg.sliding_window is not None and cfg.layer_is_sliding is not None
     )
+    # ring attention: K/V sequence-sharded over sp with rotating blocks —
+    # the context-parallel regime (ops/attention.py ring_attention)
+    use_ring = (
+        cfg.attn_impl == "ring"
+        and not per_layer_window
+        and mesh is not None
+        and mesh.shape.get("sp", 1) > 1
+    )
+    if cfg.attn_impl == "ring" and not use_ring:
+        # requesting ring implies the O(T/sp) memory regime was wanted —
+        # falling back silently would surprise at long context (trace-time
+        # warning: fires once per compiled shape)
+        import warnings
+
+        reason = (
+            "per-layer sliding windows (gemma2) are mask-based"
+            if per_layer_window
+            else "the mesh has no sp>1 axis"
+        )
+        warnings.warn(
+            f"attn_impl='ring' requested but unused: {reason}; falling "
+            "back to the splash/naive ladder",
+            stacklevel=2,
+        )
     use_splash = (
         cfg.attn_impl != "naive"
+        and not use_ring
         and not per_layer_window  # splash masks are static per kernel
         and splash_supported(
             T, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, sp=sp
         )
     )
-    # the splash path never materialises a mask; naive builds [B,1,T,T] once.
-    # With per-layer windows (gemma2) both variants are built once and each
-    # scan step selects by the layer's flag.
+    # the splash/ring paths never materialise a mask; naive builds
+    # [B,1,T,T] once.  With per-layer windows (gemma2) both variants are
+    # built once and each scan step selects by the layer's flag.
     mask_win = None
     if per_layer_window:
         mask = make_attention_mask(segment_ids, positions, None)
         mask_win = make_attention_mask(
             segment_ids, positions, cfg.sliding_window
         )
-    elif use_splash:
+    elif use_splash or use_ring:
         mask = None
     else:
         mask = make_attention_mask(segment_ids, positions, cfg.sliding_window)

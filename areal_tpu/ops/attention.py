@@ -201,6 +201,97 @@ def _splash_call(kernel, q, k, v, segment_ids, group: int):
 # ---------------------------------------------------------------------------
 
 
+def ring_attention(
+    q: jax.Array,  # [B, T, Hq, hd]
+    k: jax.Array,  # [B, T, Hkv, hd]
+    v: jax.Array,  # [B, T, Hkv, hd]
+    segment_ids: jax.Array,  # int32 [B, T], -1 = padding
+    positions: jax.Array,  # int32 [B, T]
+    mesh: Mesh,
+    sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over the `sp` mesh axis: K/V are SHARDED along the
+    sequence (unlike the splash path, where K/V stay whole per shard — the
+    Ulysses memory regime) and rotate around the ring via `ppermute`, with
+    a blockwise online softmax accumulating each visiting block.
+
+    This is the context-parallel regime the reference lacks outright
+    (SURVEY.md §2.4 "Ring attention: not present"): per-chip attention
+    memory is O(T/sp) for q AND k/v, so the context ceiling scales with the
+    ring size.  Differentiable (shard_map + ppermute transpose), segment-
+    masked, GQA-aware; causality and the optional sliding window are
+    evaluated per visiting block from the rotating (positions, segment_ids)
+    metadata, so packed rows work exactly as in the naive/splash paths.
+    """
+    sp = mesh.shape["sp"]
+    B, T, Hq, hd = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    batch = ("dp", "fsdp", "ep")
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    scale = float(1.0 / np.sqrt(hd))
+
+    def body(qb, kb, vb, segq, posq, segk, posk):
+        # qb [b, Tl, Hkv_l, group, hd]; kb/vb [b, Tl, Hkv_l, hd]
+        b, Tl = qb.shape[:2]
+        hkv = kb.shape[2]
+        m = jnp.full((b, hkv, group, Tl), MASK_VALUE, jnp.float32)
+        l = jnp.zeros((b, hkv, group, Tl), jnp.float32)
+        acc = jnp.zeros((b, hkv, group, Tl, hd), jnp.float32)
+        for _ in range(sp):
+            scores = jnp.einsum(
+                "btkgh,bskh->bkgts", qb, kb
+            ).astype(jnp.float32) * scale
+            if logit_softcap:
+                scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+            mask = (
+                (segq[:, :, None] == segk[:, None, :])
+                & (segq[:, :, None] >= 0)
+                & (posk[:, None, :] <= posq[:, :, None])
+            )
+            if sliding_window is not None:
+                mask &= posk[:, None, :] > posq[:, :, None] - sliding_window
+            mask = mask[:, None, None, :, :]  # [b,1,1,Tl,Ts]
+            # mask BEFORE the exp so its argument is always <= 0: raw masked
+            # scores minus m_new == MASK_VALUE would overflow exp to inf in
+            # the unselected where-branch and poison the backward (the
+            # where-grad trap); the outer where still zeroes the
+            # exp(0) == 1 that all-masked rows (m_new == MASK_VALUE) produce
+            smx = jnp.where(mask, scores, MASK_VALUE)
+            m_new = jnp.maximum(m, jnp.max(smx, axis=-1))
+            p = jnp.where(mask, jnp.exp(smx - m_new[..., None]), 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1)
+            acc = acc * corr[..., None] + jnp.einsum(
+                "bkgts,bskh->bkgth", p, vb.astype(jnp.float32)
+            )
+            m = m_new
+            kb, vb, segk, posk = (
+                jax.lax.ppermute(x, "sp", perm) for x in (kb, vb, segk, posk)
+            )
+        out = acc / jnp.maximum(l[..., None], 1e-20)  # pad rows: l == 0 -> 0
+        return out.astype(qb.dtype)
+
+    qg = q.reshape(B, T, Hkv, group, hd)
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(batch, "sp", "tp", None, None),  # q [B, T, Hkv, group, hd]
+            P(batch, "sp", "tp", None),  # k — sequence SHARDED
+            P(batch, "sp", "tp", None),  # v
+            P(batch, "sp"),  # q-side segment ids
+            P(batch, "sp"),  # q-side positions
+            P(batch, "sp"),  # rotating k-side segment ids
+            P(batch, "sp"),  # rotating k-side positions
+        ),
+        out_specs=P(batch, "tp", None, "sp", None),  # [B, Hkv, group, T, hd]
+        check_vma=False,
+    )(qg, k, v, segment_ids, positions, segment_ids, positions)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, Hq, hd)
+
+
 def segment_attention(
     q: jax.Array,  # [B, T, Hq, hd]
     k: jax.Array,  # [B, T, Hkv, hd]
@@ -209,7 +300,7 @@ def segment_attention(
     positions: jax.Array,  # int32 [B, T] (per-segment positions)
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
-    impl: str = "auto",  # auto | splash | naive
+    impl: str = "auto",  # auto | splash | naive | ring
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
     """Causal segment-masked self-attention over packed/padded rows.
@@ -222,6 +313,13 @@ def segment_attention(
     """
     B, T, Hq, hd = q.shape
     Hkv = k.shape[2]
+    if impl == "ring":
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            return ring_attention(
+                q, k, v, segment_ids, positions, mesh,
+                sliding_window=sliding_window, logit_softcap=logit_softcap,
+            )
+        impl = "auto"  # no ring without an sp axis — use the normal ladder
     if impl == "auto":
         sp = mesh.shape["sp"] if mesh is not None else 1
         impl = "splash" if splash_supported(T, Hq, Hkv, hd, sp=sp) else "naive"

@@ -123,6 +123,36 @@ def test_train_loss_decreases_gpt2_and_gemma2():
         eng.destroy()
 
 
+def test_train_step_ring_attention_matches_naive():
+    """attn_impl=ring (K/V sequence-sharded, rotating blocks) reproduces the
+    naive-attention loss through the full train step on a dp2 x sp2 x tp2
+    mesh — context parallelism as a drop-in numerics-preserving switch."""
+    losses = {}
+    for impl in ("naive", "ring"):
+        mc = tiny_config(vocab_size=128, qkv_bias=True,
+                         hf_architecture="Qwen2ForCausalLM", attn_impl=impl)
+        cfg = TrainEngineConfig(
+            experiment_name="t", trial_name="t", init_from_scratch=True,
+            dtype="float32", gradient_checkpointing=True,
+            mesh=MeshConfig(data_parallel_size=2, sequence_parallel_size=2,
+                            tensor_parallel_size=2),
+            mb_spec=MicroBatchSpec(n_mbs=1),
+            optimizer=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0,
+                                      weight_decay=0.0),
+            pack_length_quantum=16,
+        )
+        eng = JaxTrainEngine(cfg, model_config=mc)
+        eng.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+        rng = np.random.default_rng(3)
+        batch = _batch(rng)
+        losses[impl] = [
+            eng.train_batch(batch, sft_loss_fn, _weight)["loss"]
+            for _ in range(2)
+        ]
+        eng.destroy()
+    np.testing.assert_allclose(losses["ring"], losses["naive"], rtol=2e-4)
+
+
 def test_forward_matches_unsharded():
     rng = np.random.default_rng(2)
     batch = _batch(rng)
