@@ -421,7 +421,12 @@ class PPOConfig(GRPOConfig):
 # ---------------------------------------------------------------------------
 
 
-def _from_dict(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
+def _from_dict(
+    cls: Type[T],
+    data: Dict[str, Any],
+    path: str = "",
+    ignore_unknown_top: bool = False,
+) -> T:
     if data is None:
         data = {}
     if not isinstance(data, dict):
@@ -430,6 +435,12 @@ def _from_dict(cls: Type[T], data: Dict[str, Any], path: str = "") -> T:
     fld_map = {f.name: f for f in fields(cls)}
     for key, value in data.items():
         if key not in fld_map:
+            if ignore_unknown_top and not path:
+                # launchers parse experiment configs only for THEIR fields
+                # (gen_server, allocation_mode, ...); example-specific
+                # top-level sections (e.g. PPOConfig's `critic`) must not
+                # fail the launch — the entry point re-parses strictly
+                continue
             raise ValueError(f"unknown config key {path + key!r} for {cls.__name__}")
         kwargs[key] = _coerce(fld_map[key].type, value, path + key + ".")
     return cls(**kwargs)
@@ -510,11 +521,17 @@ def _apply_dotlist(data: Dict[str, Any], overrides: List[str]):
         node[parts[-1]] = yaml.safe_load(raw) if raw != "" else None
 
 
-def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
+def load_expr_config(
+    argv: List[str],
+    config_cls: Type[T],
+    ignore_unknown_top: bool = False,
+) -> Tuple[T, str]:
     """Parse `--config path.yaml key=value ...` into a config dataclass.
 
     Counterpart of the reference's `load_expr_config` (cli_args.py:1280).
-    Returns (config, config_file_path).
+    Returns (config, config_file_path).  `ignore_unknown_top` skips unknown
+    TOP-LEVEL yaml sections (for launchers, which parse experiment configs
+    only for the fields they own); nested typos still fail loudly.
     """
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", type=str, default=None)
@@ -529,7 +546,7 @@ def load_expr_config(argv: List[str], config_cls: Type[T]) -> Tuple[T, str]:
         with open(args.config) as f:
             data = yaml.safe_load(f) or {}
     _apply_dotlist(data, overrides)
-    cfg = _from_dict(config_cls, data)
+    cfg = _from_dict(config_cls, data, ignore_unknown_top=ignore_unknown_top)
     # propagate experiment/trial names into nested configs that carry them
     for f in fields(cfg):
         sub = getattr(cfg, f.name)

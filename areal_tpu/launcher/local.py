@@ -32,7 +32,7 @@ class LocalLauncher:
     def __init__(self, entry: str, config_args: List[str]):
         self.entry = entry
         self.config_args = config_args
-        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.config, _ = load_expr_config(config_args, GRPOConfig, ignore_unknown_top=True)
         self.procs: List[subprocess.Popen] = []
         self.server_addrs: List[str] = []
 

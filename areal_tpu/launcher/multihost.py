@@ -91,7 +91,7 @@ class MultiHostLauncher:
     ):
         self.entry = entry
         self.config_args = config_args
-        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.config, _ = load_expr_config(config_args, GRPOConfig, ignore_unknown_top=True)
         self.gen_hosts = gen_hosts
         self.train_hosts = train_hosts
         self.remote_shell = remote_shell
@@ -248,7 +248,7 @@ def main():
     if not train_hosts:
         parser.error("--train-hosts is required")
     if not gen_hosts:
-        cfg, _ = load_expr_config(config_args, GRPOConfig)
+        cfg, _ = load_expr_config(config_args, GRPOConfig, ignore_unknown_top=True)
         alloc = (
             AllocationMode.from_str(cfg.allocation_mode)
             if cfg.allocation_mode

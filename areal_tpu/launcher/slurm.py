@@ -114,7 +114,7 @@ class SlurmLauncher:
     ):
         self.entry = entry
         self.config_args = config_args
-        self.config, _ = load_expr_config(config_args, GRPOConfig)
+        self.config, _ = load_expr_config(config_args, GRPOConfig, ignore_unknown_top=True)
         self.n_gen_servers = n_gen_servers
         self.n_train_procs = n_train_procs
         self.sbatch_bin = sbatch_bin

@@ -59,11 +59,16 @@ class RecoverHandler:
         stats_logger=None,
         dataloader=None,
         tokenizer=None,
+        extra_engines=None,  # {"critic": engine, ...} — saved beside the main one
     ) -> str:
         root = self.recover_root()
         ckpt = os.path.join(root, "checkpoint")
         os.makedirs(ckpt, exist_ok=True)
         engine.save(SaveLoadMeta(path=ckpt, with_optim=True, tokenizer=tokenizer))
+        for name, eng in (extra_engines or {}).items():
+            sub = os.path.join(root, f"checkpoint_{name}")
+            os.makedirs(sub, exist_ok=True)
+            eng.save(SaveLoadMeta(path=sub, with_optim=True, tokenizer=tokenizer))
         info = RecoverInfo(
             recover_start=StepInfo(
                 epoch=step_info.epoch,
@@ -95,6 +100,7 @@ class RecoverHandler:
         dataloader=None,
         inference_engine=None,
         weight_update_meta: Optional[WeightUpdateMeta] = None,
+        extra_engines=None,  # same mapping as dump(); loaded when present
     ) -> Optional[RecoverInfo]:
         """Restore everything; if an inference engine is given, replay the
         weight upload so fresh servers serve the recovered policy."""
@@ -105,6 +111,15 @@ class RecoverHandler:
             info: RecoverInfo = pickle.load(f)
         ckpt = os.path.join(self.recover_root(), "checkpoint")
         engine.load(SaveLoadMeta(path=ckpt, with_optim=True))
+        for name, eng in (extra_engines or {}).items():
+            sub = os.path.join(self.recover_root(), f"checkpoint_{name}")
+            if os.path.isdir(sub):
+                eng.load(SaveLoadMeta(path=sub, with_optim=True))
+            else:
+                logger.warning(
+                    "recover checkpoint has no %s engine state (%s); it "
+                    "resumes from its initial weights", name, sub,
+                )
         if saver is not None and info.saver_info:
             saver.load_state_dict(info.saver_info)
         if evaluator is not None and info.evaluator_info:

@@ -1,0 +1,240 @@
+"""GSM8K PPO with a learned critic — actor + value model.
+
+The critic-based variant of the canonical GRPO loop (gsm8k_grpo.py).
+Behavioral counterpart of the reference's PPO-with-critic algorithm layer
+(lite: areal/engine/ppo/critic.py driven the same way as the actor;
+legacy: realhf ppo_math_exp actor/critic MFCs): per step the critic's
+per-token values feed GAE (advantages for the actor, returns for the
+critic), then both models update on the same rollout batch.
+
+Differences from gsm8k_grpo.py kept deliberate and small:
+- `PPOConfig` (GRPOConfig + a `critic:` section) configures a second
+  train engine that shares the actor's backbone config plus a scalar
+  value head (`engine/ppo/critic.py`).
+- `use_decoupled_loss`/group advantage normalisation still apply — the
+  decoupled objective is orthogonal to where the baseline comes from.
+- Save/recover cover BOTH models: the critic checkpoints beside the actor
+  (saver name="critic"; value-head weights ride along) and the recover
+  handler dumps/restores it via `extra_engines` so a resumed run keeps its
+  learned baseline.
+
+Launch:  python examples/math/gsm8k_ppo.py --config examples/math/gsm8k_ppo.yaml
+(or via the launcher, which also starts generation servers:
+ python -m areal_tpu.launcher.local examples/math/gsm8k_ppo.py --config ...)
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from areal_tpu.api.config import PPOConfig, load_expr_config
+from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.engine.ppo import JaxPPOActor, JaxPPOCritic
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.reward import gsm8k_reward_fn
+from areal_tpu.utils import logging, seeding, stats
+from areal_tpu.utils.dataloader import StatefulDataLoader
+from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.stats_logger import StatsLogger
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+logger = logging.getLogger("gsm8k_ppo")
+
+
+def main(argv):
+    config, _ = load_expr_config(argv, PPOConfig)
+    seeding.set_random_seed(config.seed, "trainer")
+
+    tokenizer = None
+    if config.tokenizer_path:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(config.tokenizer_path)
+
+    train_dataset = get_custom_dataset(
+        path=config.train_dataset.path,
+        type=config.train_dataset.type,
+        split="train",
+        tokenizer=tokenizer,
+        max_length=config.train_dataset.max_length,
+    )
+    dataloader = StatefulDataLoader(
+        train_dataset,
+        batch_size=config.train_dataset.batch_size,
+        shuffle=config.train_dataset.shuffle,
+        drop_last=config.train_dataset.drop_last,
+        seed=config.seed,
+    )
+    ft_spec = FinetuneSpec(
+        total_train_epochs=config.total_train_epochs,
+        dataset_size=len(train_dataset),
+        train_batch_size=config.train_dataset.batch_size,
+    )
+
+    rollout = RemoteJaxEngine(config.rollout)
+    rollout.initialize(train_data_parallel_size=1)
+
+    actor = JaxPPOActor(config.actor)
+    actor.create_process_group()
+    actor.initialize(ft_spec=ft_spec)
+
+    critic = JaxPPOCritic(config.critic)
+    critic.create_process_group()
+    critic.initialize(ft_spec=ft_spec)
+
+    ref = None
+    if config.actor.kl_ctl > 0 and config.ref is not None:
+        from areal_tpu.engine.jax_train import JaxTrainEngine
+
+        ref = JaxTrainEngine(config.ref)
+        ref.create_process_group()
+        ref.initialize(ft_spec=ft_spec)
+
+    if config.weight_update_mode == "transfer":
+        weight_meta = WeightUpdateMeta.from_transfer(
+            config.experiment_name, config.trial_name,
+            live_commit=config.weight_update_live_commit,
+        )
+    else:
+        weight_meta = WeightUpdateMeta.from_disk(
+            config.experiment_name, config.trial_name, config.cluster.fileroot
+        )
+
+    from areal_tpu.api.reward import prewarm_reward_pool
+
+    prewarm_reward_pool()
+    workflow = RLVRWorkflow(
+        reward_fn=gsm8k_reward_fn,
+        gconfig=config.gconfig,
+        tokenizer=tokenizer,
+        dump_dir=os.path.join(
+            StatsLogger.get_log_path(config.stats_logger), "generated"
+        ),
+    )
+
+    saver = Saver(config.saver, ft_spec)
+    checkpointer = Saver(config.checkpointer, ft_spec, for_recover=True)
+    stats_logger = StatsLogger(config.stats_logger)
+    recover = RecoverHandler(config.recover, ft_spec)
+
+    start_step = 0
+    if check_if_recover(config.recover, run_id=int(os.environ.get("AREAL_RUN_ID", 0))):
+        info = recover.load(
+            actor,
+            saver=saver,
+            stats_logger=stats_logger,
+            dataloader=dataloader,
+            inference_engine=rollout,
+            weight_update_meta=weight_meta,
+            extra_engines={"critic": critic},
+        )
+        if info is not None:
+            start_step = info.recover_start.global_step
+
+    if config.warm_pack_shapes:
+        actor.warm_shapes([tuple(s) for s in config.warm_pack_shapes])
+
+    total_steps = config.total_train_steps or ft_spec.total_train_steps
+    steps_per_epoch = ft_spec.steps_per_epoch
+
+    for global_step in range(start_step, total_steps):
+        epoch = global_step // steps_per_epoch
+        epoch_step = global_step % steps_per_epoch
+        step_info = StepInfo(
+            epoch=epoch, epoch_step=epoch_step, global_step=global_step,
+            steps_per_epoch=steps_per_epoch,
+        )
+
+        with stats.record_timing("rollout"):
+            if config.async_training:
+                batch = rollout.prepare_batch(dataloader, workflow=workflow)
+            else:
+                batch = rollout.rollout_batch(
+                    [train_dataset[i % len(train_dataset)]
+                     for i in range(
+                         global_step * config.train_dataset.batch_size,
+                         (global_step + 1) * config.train_dataset.batch_size,
+                     )],
+                    workflow=workflow,
+                )
+
+        if config.actor.recompute_logprob:
+            with stats.record_timing("recompute_logp"):
+                batch["prox_logp"] = actor.compute_logp(batch)
+
+        # the critic's per-token values are the GAE baseline (the whole
+        # point of PPO-with-critic vs GRPO's group-mean baseline)
+        with stats.record_timing("compute_values"):
+            batch["values"] = critic.compute_values(batch)
+
+        if ref is not None:
+            with stats.record_timing("ref_logp"):
+                batch["ref_logp"] = ref.forward(batch)
+
+        with stats.record_timing("compute_advantages"):
+            actor.compute_advantages(batch)  # consumes values -> returns
+
+        with stats.record_timing("ppo_update"):
+            train_stats = actor.ppo_update(batch)
+            actor.step_lr_scheduler()
+
+        with stats.record_timing("critic_update"):
+            # prefix so critic loss/grad_norm don't shadow the actor's in
+            # the merged commit line
+            critic_stats = [
+                {f"critic/{k}": v for k, v in d.items()}
+                for d in critic.ppo_update(batch)
+            ]
+            critic.step_lr_scheduler()
+
+        with stats.record_timing("stage_weights"):
+            actor.set_version(global_step + 1)
+            actor.stage_weights(weight_meta)
+        with stats.record_timing("update_weights"):
+            rollout.pause()
+            actor.update_weights(weight_meta)
+            rollout.update_weights(weight_meta)
+            rollout.set_version(global_step + 1)
+            rollout.resume()
+
+        with stats.record_timing("save"):
+            saved = saver.save(
+                actor, epoch, epoch_step, global_step, tokenizer=tokenizer
+            )
+            if saved is not None:
+                # the trained critic (backbone + value head) checkpoints
+                # beside the actor — force, since the actor's save already
+                # consumed this step's frequency trigger
+                saver.save(critic, epoch, epoch_step, global_step,
+                           name="critic", force=True, tokenizer=tokenizer)
+            if checkpointer.freq.check(epoch, global_step):
+                recover.dump(
+                    actor, step_info, saver=saver,
+                    stats_logger=stats_logger, dataloader=dataloader,
+                    tokenizer=tokenizer,
+                    extra_engines={"critic": critic},
+                )
+
+        actor.flush_stats()
+        reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
+        stats.scalar(reward=reward_mean, n_seqs=len(batch.get("rewards", [])))
+        stats_logger.commit(
+            epoch, epoch_step, global_step,
+            [stats.export()] + train_stats + critic_stats,
+        )
+        logger.info(
+            f"Epoch {epoch + 1}/{config.total_train_epochs} "
+            f"Step {epoch_step + 1}/{steps_per_epoch} "
+            f"(global {global_step + 1}/{total_steps}) done. "
+            f"reward={reward_mean:.3f}"
+        )
+
+    rollout.destroy()
+    stats_logger.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -115,6 +115,37 @@ def test_recover_roundtrip(tmp_path):
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-4)
 
 
+def test_recover_roundtrip_extra_engines(tmp_path):
+    """extra_engines (the PPO critic pattern, examples/math/gsm8k_ppo.py)
+    dump and restore beside the main engine."""
+    rng = np.random.default_rng(1)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    actor, second = _engine(), _engine()
+    for _ in range(2):
+        actor.train_lm(batch)
+        second.train_lm(batch)
+
+    cfg = RecoverConfig(mode="auto", experiment_name="e2", trial_name="t",
+                        fileroot=str(tmp_path))
+    handler = RecoverHandler(cfg)
+    step = StepInfo(epoch=0, epoch_step=1, global_step=1, steps_per_epoch=8)
+    handler.dump(actor, step, extra_engines={"second": second})
+    ref = second.forward(batch)
+
+    actor2, second2 = _engine(), _engine()
+    info = handler.load(actor2, extra_engines={"second": second2})
+    assert info is not None
+    np.testing.assert_allclose(second2.forward(batch), ref, rtol=1e-4,
+                               atol=1e-4)
+    # a missing extra checkpoint degrades with a warning, not a crash
+    info = handler.load(_engine(), extra_engines={"absent": _engine()})
+    assert info is not None
+
+
 def test_check_if_recover_modes(tmp_path):
     cfg = RecoverConfig(mode="disabled", experiment_name="e", trial_name="t",
                         fileroot=str(tmp_path))
