@@ -83,3 +83,32 @@ def test_flag_style_override_rejected():
         raise AssertionError("should have raised")
     except ValueError as e:
         assert "actor.lr" in str(e)
+
+
+def test_ignore_unknown_top_level_only(tmp_path):
+    """Launchers parse experiment configs leniently at the TOP level (an
+    example-specific section like PPOConfig's `critic` must not fail the
+    launch) while nested typos still error loudly."""
+    import pytest
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        "experiment_name: e\ntrial_name: t\n"
+        "critic:\n  value_eps_clip: 0.2\n"   # unknown to GRPOConfig
+        "actor:\n  group_size: 4\n"
+    )
+    cfg, _ = load_expr_config(
+        ["--config", str(p)], GRPOConfig, ignore_unknown_top=True
+    )
+    assert cfg.actor.group_size == 4
+
+    # strict callers (the entry points) still reject the same file
+    with pytest.raises(ValueError, match="critic"):
+        load_expr_config(["--config", str(p)], GRPOConfig)
+
+    # nested typos fail even in lenient mode
+    p2 = tmp_path / "cfg2.yaml"
+    p2.write_text("experiment_name: e\ntrial_name: t\nactor:\n  grp_size: 4\n")
+    with pytest.raises(ValueError, match="grp_size"):
+        load_expr_config(["--config", str(p2)], GRPOConfig,
+                         ignore_unknown_top=True)
