@@ -111,7 +111,11 @@ class TrainEngineConfig:
     # this quantum; avoids XLA recompilation storms on variable-length data.
     pack_length_quantum: int = 512
     max_pack_length: int = 32768
-    attn_impl: str = "auto"  # auto | pallas_splash | xla
+    # forwarded onto the model config at initialize: "auto" picks the
+    # splash kernel when shapes allow; "ring" turns an sp>1 mesh axis
+    # (alloc `s`/`c` dims) into ring attention — K/V sequence-sharded
+    # context parallelism (ops/attention.py ring_attention)
+    attn_impl: str = "auto"  # auto | splash | naive | ring
     # Defer the per-step stats fetch so consecutive train steps pipeline on
     # the device (the fetch otherwise serialises the trainer on dispatch
     # latency — large on tunneled TPU runtimes).  train_batch then returns a

@@ -139,6 +139,16 @@ class JaxTrainEngine(TrainEngine):
         self.create_process_group()
         self._ft_spec = ft_spec
         cfg = self.config
+        if getattr(cfg, "attn_impl", "auto") not in (
+            "auto", "splash", "naive", "ring",
+        ):
+            # forwarded verbatim into the model config: an unknown value
+            # (typo, or this field's pre-wiring legacy spellings) would
+            # silently select the splash/auto ladder
+            raise ValueError(
+                f"unknown attn_impl {cfg.attn_impl!r}: use auto, splash, "
+                "naive, or ring"
+            )
         if (
             self.model_config is not None
             and self.model_config.pos_emb == "learned"
@@ -175,6 +185,13 @@ class JaxTrainEngine(TrainEngine):
             remat=cfg.gradient_checkpointing,
             remat_policy=getattr(cfg, "remat_policy", "full"),
             scan_unroll=getattr(cfg, "scan_unroll", 1),
+            # an explicitly-set model config wins; the engine config is the
+            # yaml-reachable path for checkpoints (from_hf leaves "auto")
+            attn_impl=(
+                self.model_config.attn_impl
+                if self.model_config.attn_impl != "auto"
+                else getattr(cfg, "attn_impl", "auto")
+            ),
         )
         if getattr(cfg, "lora", None) is not None and cfg.lora.enabled:
             from areal_tpu.models.lora import add_lora_params
