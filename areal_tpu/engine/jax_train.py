@@ -149,9 +149,24 @@ class JaxTrainEngine(TrainEngine):
                 f"unknown attn_impl {cfg.attn_impl!r}: use auto, splash, "
                 "naive, or ring"
             )
+        if cfg.path and not cfg.init_from_scratch:
+            host_params, mc = load_hf_params(
+                cfg.path, self.model_config, dtype=cfg.param_dtype
+            )
+            self.model_config = mc
+        else:
+            if self.model_config is None:
+                raise ValueError("init_from_scratch requires model_config")
+            host_params = init_params(
+                self.model_config.replace(param_dtype=cfg.param_dtype),
+                jax.random.PRNGKey(0),
+            )
+        # this clamp must run AFTER the checkpoint resolves model_config:
+        # the common route (gpt2 checkpoint via cfg.path, model_config=None)
+        # only learns pos_emb=='learned' from the loaded config, and the
+        # packer's row shapes are compiled from max_pack_length below
         if (
-            self.model_config is not None
-            and self.model_config.pos_emb == "learned"
+            self.model_config.pos_emb == "learned"
             and cfg.max_pack_length > self.model_config.max_position_embeddings
         ):
             # jnp.take clamps, so rows packed past the table would silently
@@ -166,19 +181,6 @@ class JaxTrainEngine(TrainEngine):
                 self.model_config.max_position_embeddings,
             )
             cfg.max_pack_length = self.model_config.max_position_embeddings
-
-        if cfg.path and not cfg.init_from_scratch:
-            host_params, mc = load_hf_params(
-                cfg.path, self.model_config, dtype=cfg.param_dtype
-            )
-            self.model_config = mc
-        else:
-            if self.model_config is None:
-                raise ValueError("init_from_scratch requires model_config")
-            host_params = init_params(
-                self.model_config.replace(param_dtype=cfg.param_dtype),
-                jax.random.PRNGKey(0),
-            )
         self.model_config = self.model_config.replace(
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,

@@ -273,6 +273,17 @@ class TransformerConfig:
         eos = d.get("eos_token_id", 2)
         if isinstance(eos, list):
             eos = eos[0]
+        # activation key precedence per model type, matching transformers
+        # >=4.57: Gemma2MLP reads config.hidden_activation (default tanh),
+        # GemmaMLP reads config.hidden_act only (hidden_activation ignored,
+        # legacy 'gelu' runs EXACT gelu), everything else reads hidden_act —
+        # pinned by test_legacy_gemma_act_parity
+        if model_type == "gemma2":
+            hidden_act = d.get("hidden_activation") or "gelu_pytorch_tanh"
+        elif gemma:
+            hidden_act = d.get("hidden_act") or "gelu_pytorch_tanh"
+        else:
+            hidden_act = d.get("hidden_act") or "silu"
         return cls(
             vocab_size=d["vocab_size"],
             hidden_size=d["hidden_size"],
@@ -289,11 +300,7 @@ class TransformerConfig:
             qk_norm=qk_norm,
             sliding_window=sliding_window,
             layer_is_sliding=layer_is_sliding,
-            hidden_act=(
-                d.get("hidden_activation")
-                or d.get("hidden_act")
-                or ("gelu_pytorch_tanh" if gemma else "silu")
-            ),
+            hidden_act=hidden_act,
             scale_embeddings=gemma,
             norm_unit_offset=gemma,
             sandwich_norms=model_type == "gemma2",

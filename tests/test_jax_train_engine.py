@@ -260,3 +260,39 @@ def test_async_stats_pipeline_matches_sync():
     assert not seen
     _ = p["loss"]
     assert seen == [True]
+
+
+def test_learned_pos_clamp_applies_on_checkpoint_route(tmp_path):
+    """The common route — gpt2 checkpoint given via cfg.path with
+    model_config=None — only learns pos_emb=='learned' from the loaded
+    config, so the max_pack_length clamp must run after the checkpoint
+    resolves (r4 advisor: the guard previously ran before load_hf_params
+    and silently skipped, training overflow positions on the last wpe row)."""
+    import jax
+
+    from areal_tpu.models import init_params
+    from areal_tpu.models.hf import save_hf_checkpoint
+
+    mc = tiny_config(
+        vocab_size=128, hf_architecture="GPT2LMHeadModel",
+        norm_type="layernorm", pos_emb="learned", mlp_gated=False,
+        qkv_bias=True, attn_output_bias=True, mlp_bias=True, num_kv_heads=4,
+        hidden_act="gelu_pytorch_tanh", tie_word_embeddings=True,
+        max_position_embeddings=32,
+    )
+    ckpt = tmp_path / "gpt2"
+    save_hf_checkpoint(init_params(mc, jax.random.PRNGKey(0)), mc, str(ckpt),
+                       save_dtype="float32")
+    cfg = TrainEngineConfig(
+        experiment_name="t", trial_name="t", path=str(ckpt),
+        dtype="float32", gradient_checkpointing=False,
+        mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+        optimizer=OptimizerConfig(lr=1e-2, warmup_steps_proportion=0.0,
+                                  weight_decay=0.0),
+        pack_length_quantum=16, max_pack_length=4096,
+    )
+    eng = JaxTrainEngine(cfg, model_config=None)
+    eng.initialize(ft_spec=FinetuneSpec(1, 64, 8))
+    assert eng.config.max_pack_length == 32
+    eng.destroy()

@@ -347,3 +347,71 @@ def test_qwen3_moe_hf_parity_and_roundtrip(tmp_path):
     with torch.no_grad():
         ref2 = reloaded(torch.from_numpy(ids).long()).logits.numpy()
     np.testing.assert_allclose(ref2, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_legacy_gemma_act_parity(tmp_path):
+    """Legacy gemma-1 configs carry hidden_act='gelu' with no
+    hidden_activation key. transformers>=4.57 GemmaMLP runs
+    ACT2FN[config.hidden_act] verbatim (the old runtime tanh override is
+    gone), so from_hf must NOT coerce — pin end-to-end forward parity on
+    exactly that config shape so a future transformers flip fails loudly."""
+    import torch
+    import transformers
+
+    model, ckpt = _hf_tiny("gemma", tmp_path)
+    # rewrite config.json into the legacy gemma-1 shape
+    p = ckpt + "/config.json"
+    d = json.loads(open(p).read())
+    d.pop("hidden_activation", None)
+    d["hidden_act"] = "gelu"
+    open(p, "w").write(json.dumps(d))
+
+    # what does the installed transformers actually run for this config?
+    reloaded = transformers.GemmaForCausalLM.from_pretrained(ckpt).eval()
+    act_name = type(reloaded.model.layers[0].mlp.act_fn).__name__
+
+    params, cfg = load_hf_params(ckpt)
+    assert (cfg.hidden_act == "gelu") == (act_name == "GELUActivation"), (
+        cfg.hidden_act, act_name,
+    )
+    cfg = cfg.replace(dtype="float32", remat=False)
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, cfg.vocab_size, (2, 17)).astype(np.int32)
+    with torch.no_grad():
+        ref = reloaded(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.broadcast_to(np.arange(17, dtype=np.int32), (2, 17))
+    seg = np.broadcast_to(np.arange(2, dtype=np.int32)[:, None], (2, 17))
+    got = np.asarray(forward(params, cfg, ids, pos, seg))
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+    # both-keys divergent case (HF's transitional gemma-1 config shape):
+    # GemmaMLP ignores hidden_activation, so hidden_act must win
+    d["hidden_activation"] = "gelu_pytorch_tanh"
+    open(p, "w").write(json.dumps(d))
+    reloaded = transformers.GemmaForCausalLM.from_pretrained(ckpt).eval()
+    act_name = type(reloaded.model.layers[0].mlp.act_fn).__name__
+    _, cfg = load_hf_params(ckpt)
+    assert (cfg.hidden_act == "gelu") == (act_name == "GELUActivation"), (
+        cfg.hidden_act, act_name,
+    )
+
+    # hidden_activation-only gemma-1: GemmaConfig leaves hidden_act at its
+    # default, so HF runs tanh — hidden_activation must be ignored
+    del d["hidden_act"]
+    d["hidden_activation"] = "gelu"
+    open(p, "w").write(json.dumps(d))
+    reloaded = transformers.GemmaForCausalLM.from_pretrained(ckpt).eval()
+    act_name = type(reloaded.model.layers[0].mlp.act_fn).__name__
+    _, cfg = load_hf_params(ckpt)
+    assert (cfg.hidden_act == "gelu_pytorch_tanh") == (act_name == "GELUTanh"), (
+        cfg.hidden_act, act_name,
+    )
+
+    # inverse for gemma-2: Gemma2MLP reads config.hidden_activation, which
+    # defaults to tanh even when a config carries only hidden_act
+    cfg2 = TransformerConfig.from_hf(dict(
+        architectures=["Gemma2ForCausalLM"], model_type="gemma2",
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, hidden_act="gelu",
+    ))
+    assert cfg2.hidden_act == "gelu_pytorch_tanh"
