@@ -51,7 +51,10 @@ BENCHMARKS: Dict[str, BenchmarkSpec] = {
         BenchmarkSpec(
             "gpqa_diamond",
             "gpqa_diamond/test.jsonl",
-            "question",
+            # the dataset's 'question' field already embeds the lettered
+            # options; build from the raw question + labeled_options so the
+            # options appear exactly once (every row carries both fields)
+            "ori_question",
             "answer",
             instruction=CHOICE_INSTRUCTION,
             options_field="labeled_options",
@@ -93,7 +96,14 @@ def load_benchmark(
             if not line:
                 continue
             row = json.loads(line)
-            q = row[spec.question_field]
+            # multiple-choice exports predating the ori_question spec carry
+            # only 'question' (options already embedded); math benchmarks
+            # keep their loud KeyError on a malformed row
+            legacy = (
+                spec.options_field is not None
+                and spec.question_field not in row
+            )
+            q = row["question"] if legacy else row[spec.question_field]
             if spec.options_field and spec.options_field in row:
                 opts = row[spec.options_field]
                 if isinstance(opts, str):
@@ -105,7 +115,15 @@ def load_benchmark(
                         opts = ast.literal_eval(opts)
                     else:
                         opts = [opts]
-                q = q + "\n" + "\n".join(str(o) for o in opts)
+                # the embedded-already check applies ONLY to the legacy
+                # shape, and skips appending only when EVERY option is
+                # present verbatim: an ori_question that merely quotes one
+                # option, or a legacy row with reformatted embeddings,
+                # still gets the full canonical list appended
+                if opts and (
+                    not legacy or not all(str(o) in q for o in opts)
+                ):
+                    q = q + "\n" + "\n".join(str(o) for o in opts)
             problems.append(
                 {
                     "messages": [

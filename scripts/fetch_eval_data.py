@@ -34,7 +34,9 @@ def fetch(root: str, benchmarks):
                 "license click-through) and cannot be fetched here; accept "
                 "the license on the HF hub and export rows as "
                 f"{os.path.join(root, 'gpqa_diamond', 'test.jsonl')} with "
-                "fields question/labeled_options/answer, or point "
+                "fields ori_question (options NOT embedded) / "
+                "labeled_options / answer — a plain 'question' field also "
+                "works, options are appended only when missing — or point "
                 "AREAL_EVAL_DATA at an existing benchmark-data checkout."
             )
             continue
