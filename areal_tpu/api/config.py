@@ -167,6 +167,9 @@ class PPOActorConfig(TrainEngineConfig):
     temperature: float = 1.0
     # rewards
     group_reward_norm: bool = False
+    # full-control reward normalization (lite_ppo group-mean/batch-std,
+    # dr.grpo group-mean/no-std); overrides group_reward_norm when set
+    reward_norm: Optional[NormConfig] = None
     reward_scaling: float = 1.0
     reward_bias: float = 0.0
     reward_clip: float = 20.0

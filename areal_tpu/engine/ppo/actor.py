@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from areal_tpu.api.config import PPOActorConfig
+from areal_tpu.api.config import NormConfig, PPOActorConfig
 from areal_tpu.engine.jax_train import JaxTrainEngine
 from areal_tpu.ops.functional import grpo_loss_fn
 from areal_tpu.ops.gae import gae_padded
@@ -62,30 +62,30 @@ class PPOActor:
         self.config = config
         self.engine = engine
         self._pending_stats: List[stats.PendingTrainStats] = []
-        if config.adv_norm is not None:
+        def make_norm(norm_cfg):
+            if norm_cfg is None:
+                return None
             # NormConfig.group_size overrides when set; default to the GRPO
             # group size so the common case needs no duplication
-            norm_group = (
-                config.adv_norm.group_size
-                if config.adv_norm.group_size > 1
-                else config.group_size
+            return Normalization(
+                mean_level=norm_cfg.mean_level,
+                std_level=norm_cfg.std_level,
+                group_size=(
+                    norm_cfg.group_size
+                    if norm_cfg.group_size > 1
+                    else config.group_size
+                ),
+                eps=norm_cfg.eps,
             )
-            self.adv_norm = Normalization(
-                mean_level=config.adv_norm.mean_level,
-                std_level=config.adv_norm.std_level,
-                group_size=norm_group,
-                eps=config.adv_norm.eps,
-            )
-        else:
-            self.adv_norm = None
-        self.reward_norm = (
-            Normalization(
-                mean_level="group",
-                std_level="group",
-                group_size=config.group_size,
-            )
-            if config.group_reward_norm
-            else None
+
+        self.adv_norm = make_norm(config.adv_norm)
+        # explicit NormConfig wins: the recipe variants shape rewards
+        # differently (dr.grpo removes the std division entirely, lite_ppo
+        # uses group mean + batch std); group_reward_norm is the legacy
+        # group/group switch
+        self.reward_norm = make_norm(
+            config.reward_norm
+            or (NormConfig() if config.group_reward_norm else None)
         )
 
     # ------------------------------------------------------------------

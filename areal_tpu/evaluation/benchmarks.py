@@ -39,6 +39,9 @@ class BenchmarkSpec:
     instruction: str = BOXED_INSTRUCTION
     # multiple-choice benchmarks render labeled options under the question
     options_field: Optional[str] = None
+    # schema-level fallback for exports predating question_field (options
+    # already embedded there); benchmarks without one keep a loud KeyError
+    legacy_question_field: Optional[str] = None
 
 
 BENCHMARKS: Dict[str, BenchmarkSpec] = {
@@ -58,6 +61,7 @@ BENCHMARKS: Dict[str, BenchmarkSpec] = {
             "answer",
             instruction=CHOICE_INSTRUCTION,
             options_field="labeled_options",
+            legacy_question_field="question",
         ),
     ]
 }
@@ -96,14 +100,15 @@ def load_benchmark(
             if not line:
                 continue
             row = json.loads(line)
-            # multiple-choice exports predating the ori_question spec carry
-            # only 'question' (options already embedded); math benchmarks
-            # keep their loud KeyError on a malformed row
             legacy = (
-                spec.options_field is not None
+                spec.legacy_question_field is not None
                 and spec.question_field not in row
             )
-            q = row["question"] if legacy else row[spec.question_field]
+            q = (
+                row[spec.legacy_question_field]
+                if legacy
+                else row[spec.question_field]
+            )
             if spec.options_field and spec.options_field in row:
                 opts = row[spec.options_field]
                 if isinstance(opts, str):

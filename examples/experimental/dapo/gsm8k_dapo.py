@@ -1,0 +1,43 @@
+"""GSM8K DAPO — GRPO with the DAPO recipe knobs.
+
+Counterpart of the reference's `examples/experimental/dapo/gsm8k_dapo.py`
+(which duplicates the whole GRPO main): here the training loop is the one
+from `examples/math/gsm8k_grpo.py`, and DAPO is pure configuration —
+`gsm8k_dapo.yaml` sets the recipe's four levers:
+
+- asymmetric clipping (`eps_clip: 0.2`, `eps_clip_higher: 0.28`) — the
+  "clip-higher" rule that keeps uplifting low-probability tokens
+  (reference yaml: examples/experimental/dapo/gsm8k_dapo.yaml:57-58)
+- soft overlong penalty (`overlong_reward_penalty`, `overlong_tokens: 512`,
+  `overlong_penalty_factor: 1.0` against the generation budget)
+- dynamic sampling (`dynamic_sampling: true`): all-same-reward groups are
+  dropped from the update
+- token-level loss over the group (group-mean reward norm, no KL)
+
+Launch:
+    python examples/experimental/dapo/gsm8k_dapo.py \
+        --config examples/experimental/dapo/gsm8k_dapo.yaml
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def _load_grpo_main():
+    spec = importlib.util.spec_from_file_location(
+        "gsm8k_grpo_shared",
+        os.path.join(_REPO, "examples", "math", "gsm8k_grpo.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    _load_grpo_main()(sys.argv[1:])

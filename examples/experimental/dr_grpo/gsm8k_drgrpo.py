@@ -1,0 +1,38 @@
+"""GSM8K Dr.GRPO — GRPO done right: no per-group std division.
+
+Counterpart of the reference's `examples/experimental/dr.grpo/
+gsm8k_drgrpo.py`. Dr.GRPO's fix is configuration, not code: dividing each
+group's advantage by the group's reward std up-weights near-deterministic
+groups (all-right/all-wrong) and biases the objective; the recipe keeps the
+group-mean baseline but drops the std division (`reward_norm.std_level:
+null`, reference yaml: examples/experimental/dr.grpo/gsm8k_drgrpo.yaml),
+widens the clip (`eps_clip: 0.4`), and normalizes advantages at batch
+level. The training loop is `examples/math/gsm8k_grpo.py`.
+
+Launch:
+    python examples/experimental/dr_grpo/gsm8k_drgrpo.py \
+        --config examples/experimental/dr_grpo/gsm8k_drgrpo.yaml
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def _load_grpo_main():
+    spec = importlib.util.spec_from_file_location(
+        "gsm8k_grpo_shared",
+        os.path.join(_REPO, "examples", "math", "gsm8k_grpo.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    _load_grpo_main()(sys.argv[1:])

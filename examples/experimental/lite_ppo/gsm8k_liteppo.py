@@ -1,0 +1,38 @@
+"""GSM8K LitePPO — the minimalist recipe: group-mean reward centering +
+batch-level std + wide clip, nothing else.
+
+Counterpart of the reference's `examples/experimental/lite_ppo/
+gsm8k_liteppo.py`. LitePPO's claim is that two components carry RL4LLM:
+advantages = (reward - group mean) / batch std (`reward_norm.mean_level:
+group`, `std_level: batch`, reference yaml: examples/experimental/
+lite_ppo/gsm8k_liteppo.yaml) and token-level loss with a wide clip
+(`eps_clip: 0.4`) — no KL, no dynamic sampling, no length penalty. The
+training loop is `examples/math/gsm8k_grpo.py`.
+
+Launch:
+    python examples/experimental/lite_ppo/gsm8k_liteppo.py \
+        --config examples/experimental/lite_ppo/gsm8k_liteppo.yaml
+"""
+
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+
+def _load_grpo_main():
+    spec = importlib.util.spec_from_file_location(
+        "gsm8k_grpo_shared",
+        os.path.join(_REPO, "examples", "math", "gsm8k_grpo.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    _load_grpo_main()(sys.argv[1:])
