@@ -398,8 +398,12 @@ class GRPOConfig(BaseExperimentConfig):
     weight_update_mode: str = "disk"
     # transfer mode only: commit staged weights WITHOUT aborting in-flight
     # generation (swap_weights_live — requests keep decoding across the
-    # publish, per-token versions record the transition)
-    weight_update_live_commit: bool = False
+    # publish, per-token versions record the transition).  Default ON: the
+    # measured abort-and-resume choreography sinks async below sync
+    # (E2E_GRPO_BENCH_r04 publish_mode_interrupt 0.736x) while the live
+    # commit keeps the pipeline saturated; set False to reproduce the
+    # reference's abort-only behavior (SGLang cannot hot-swap mid-request)
+    weight_update_live_commit: bool = True
     # (n_sequences, seq_len) pack signatures to AOT-compile before step 0
     # (PPOActor.warm_shapes): varying rollout lengths otherwise trigger XLA
     # compiles INSIDE the training loop the first time each signature lands

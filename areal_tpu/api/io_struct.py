@@ -135,9 +135,11 @@ class WeightUpdateMeta:
     use_lora: bool = False
     # transfer commits only: swap without aborting in-flight generation
     # (GenEngine.swap_weights_live semantics — requests keep decoding, the
-    # policy transition is recorded in per-token versions).  Default keeps
-    # the abort-and-resume interruption choreography.
-    live_commit: bool = False
+    # policy transition is recorded in per-token versions).  Default ON —
+    # abort-and-resume measurably sinks async throughput below sync
+    # (E2E_GRPO_BENCH_r04 publish_mode_interrupt); False reproduces the
+    # reference's abort-only choreography.
+    live_commit: bool = True
     # identify the trial for the name_resolve version handshake
     experiment_name: str = ""
     trial_name: str = ""
@@ -174,7 +176,7 @@ class WeightUpdateMeta:
         trial_name: str = "",
         alloc_mode: Optional["AllocationMode"] = None,
         chunk_mb: int = 256,
-        live_commit: bool = False,
+        live_commit: bool = True,
     ) -> "WeightUpdateMeta":
         return cls(
             type="transfer",

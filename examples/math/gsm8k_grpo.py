@@ -250,12 +250,19 @@ def main(argv):
             actor.set_version(global_step + 1)
             actor.stage_weights(weight_meta)
         with stats.record_timing("update_weights"):
-            rollout.pause()
+            # a live transfer commit swaps without aborting — the server
+            # keeps decoding through the publish, so the client pipeline
+            # need not pause; only the abort choreography drains in-flight
+            live = (weight_meta.type == "transfer"
+                    and weight_meta.live_commit)
+            if not live:
+                rollout.pause()
             actor.update_weights(weight_meta)
             rollout.update_weights(weight_meta)
             rollout.set_version(global_step + 1)
             eval_rollout.set_version(global_step + 1)
-            rollout.resume()
+            if not live:
+                rollout.resume()
 
         with stats.record_timing("save_eval"):
             saver.save(actor, epoch, epoch_step, global_step, tokenizer=tokenizer)
