@@ -87,7 +87,9 @@ def main():
     # jit-init wait time to the transfer path
     jax.block_until_ready(trainer.params)
     os.environ["AREAL_LLM_SERVER_ADDRS"] = addr
-    meta = WeightUpdateMeta.from_transfer("wsync", "t")
+    # abort-commit path pinned: the bench measures the stream+commit
+    # choreography the non-live fleet default used through r4
+    meta = WeightUpdateMeta.from_transfer("wsync", "t", live_commit=False)
     t0 = time.perf_counter()
     trainer._update_weights_transfer(meta)
     transfer_s = time.perf_counter() - t0

@@ -210,7 +210,8 @@ def test_grpo_transfer_weight_sync(tmp_path):
 
     try:
         # --- transfer path: chunk small enough to force multi-part arrays
-        meta_t = WeightUpdateMeta.from_transfer("e2e-tr", "t", chunk_mb=1)
+        meta_t = WeightUpdateMeta.from_transfer("e2e-tr", "t", chunk_mb=1,
+                                        live_commit=False)
         actor.set_version(1)
         t0 = time.perf_counter()
         actor.update_weights(meta_t)
@@ -310,7 +311,8 @@ def test_staged_weight_sync_splits_push_from_commit(tmp_path):
     )
     actor.initialize(ft_spec=FinetuneSpec(1, 16, 4))
     try:
-        meta = WeightUpdateMeta.from_transfer("e2e-st", "t", chunk_mb=1)
+        meta = WeightUpdateMeta.from_transfer("e2e-st", "t", chunk_mb=1,
+                                      live_commit=False)
         actor.set_version(1)
         actor.stage_weights(meta)
         # staged but NOT swapped: server still serves version 0 un-paused.

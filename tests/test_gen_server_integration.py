@@ -28,19 +28,13 @@ CFG = tiny_config(vocab_size=89, qkv_bias=True, hf_architecture="Qwen2ForCausalL
                   eos_token_id=None)
 
 
-@pytest.fixture(scope="module")
-def live_server():
-    import jax
-
-    params = init_params(CFG, jax.random.PRNGKey(0))
-    engine = GenEngine(CFG, params=params, n_slots=4, max_seq_len=96,
-                       prompt_bucket=16)
+def _boot_server(engine: GenEngine):
+    """Start a GenServer + aiohttp loop thread around `engine`; returns
+    (server, addr, stop) where stop() tears the loop down."""
     server = GenServer(engine)
     server.start()
     port = network.find_free_port()
-
     loop = asyncio.new_event_loop()
-    runner_box = {}
 
     def run():
         asyncio.set_event_loop(loop)
@@ -48,7 +42,6 @@ def live_server():
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, "127.0.0.1", port)
         loop.run_until_complete(site.start())
-        runner_box["runner"] = runner
         loop.run_forever()
 
     t = threading.Thread(target=run, daemon=True)
@@ -64,9 +57,24 @@ def live_server():
             time.sleep(0.1)
     else:
         raise RuntimeError("server did not come up")
-    yield engine, f"127.0.0.1:{port}"
-    server.shutdown.set()
-    loop.call_soon_threadsafe(loop.stop)
+
+    def stop():
+        server.shutdown.set()
+        loop.call_soon_threadsafe(loop.stop)
+
+    return server, f"127.0.0.1:{port}", stop
+
+
+@pytest.fixture(scope="module")
+def live_server():
+    import jax
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    engine = GenEngine(CFG, params=params, n_slots=4, max_seq_len=96,
+                       prompt_bucket=16)
+    _, addr, stop = _boot_server(engine)
+    yield engine, addr
+    stop()
 
 
 def _client(addr, **kw) -> RemoteJaxEngine:
@@ -234,36 +242,9 @@ def race_server():
     params = init_params(CFG, jax.random.PRNGKey(5))
     engine = GenEngine(CFG, params=params, n_slots=4, max_seq_len=1024,
                        prompt_bucket=16)
-    server = GenServer(engine)
-    server.start()
-    port = network.find_free_port()
-
-    loop = asyncio.new_event_loop()
-
-    def run():
-        asyncio.set_event_loop(loop)
-        runner = web.AppRunner(server.app())
-        loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "127.0.0.1", port)
-        loop.run_until_complete(site.start())
-        loop.run_forever()
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    deadline = time.time() + 10
-    import urllib.request
-
-    while time.time() < deadline:
-        try:
-            urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=1)
-            break
-        except Exception:
-            time.sleep(0.1)
-    else:
-        raise RuntimeError("server did not come up")
-    yield engine, f"127.0.0.1:{port}"
-    server.shutdown.set()
-    loop.call_soon_threadsafe(loop.stop)
+    _, addr, stop = _boot_server(engine)
+    yield engine, addr
+    stop()
 
 
 def test_live_commit_races_concurrent_generation(race_server):
