@@ -39,6 +39,13 @@ Design for XLA's static shapes:
   weight reload keeps old-policy KV behind new-policy decoding — exactly
   the mixed-version trajectory regime decoupled PPO + per-token versions
   are built for; set `retain_kv_on_reload=False` for strict recompute.
+- **Abort-storm discipline** (VERDICT r4 #3): admission drains a window of
+  the pending queue and prefix-matches it against every free slot globally
+  (highest lcp first) before fresh prompts get slots, and abort-freed
+  slots carry a short reservation (`abort_reserve_s`) that withholds them
+  from fresh prompts until their aborted owner has had an RTT to
+  resubmit — so a publish that aborts N in-flight requests over few slots
+  no longer hands the retained prefixes to whoever arrives first.
 """
 
 import queue
@@ -116,6 +123,8 @@ class GenEngine:
         kv_reuse: bool = True,
         reuse_min_tokens: int = 16,
         retain_kv_on_reload: bool = True,
+        abort_reserve_s: float = 1.0,
+        admission_window: Optional[int] = None,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -221,6 +230,20 @@ class GenEngine:
         self.retain_kv_on_reload = retain_kv_on_reload
         self.seq_tokens = np.zeros((S, max_seq_len), np.int32)
         self.retained_len = np.zeros(S, np.int32)  # cache-valid prefix (free slots)
+        # abort-storm protection (VERDICT r4 #3): slots freed by an abort
+        # keep a short reservation so a fresh prompt arriving before the
+        # aborted request's resubmission cannot overwrite its retained
+        # prefix; admission also scans a WINDOW of the pending queue and
+        # prefix-matches globally before handing any slot to a fresh prompt
+        self.abort_reserve_s = abort_reserve_s
+        self.admission_window = admission_window or max(64, 4 * n_slots)
+        self._reserved_until = np.zeros(S, np.float64)
+        self._holdback: List[GenRequest] = []  # drained but not yet admitted
+        # no-progress guard: a pass that parked everything records the slot
+        # set + earliest reservation expiry so subsequent steps skip the
+        # O(window x slots) rescan until something can actually change
+        self._parked_free: Optional[frozenset] = None
+        self._parked_until: float = 0.0
         self._slot_vlm = np.zeros(S, bool)  # VLM slots never reuse (mrope)
         self.stats = {
             "prefill_calls": 0,
@@ -343,12 +366,23 @@ class GenEngine:
 
     def active_count(self) -> int:
         with self._lock:
-            return sum(r is not None for r in self.slot_req) + self.pending.qsize()
+            return (
+                sum(r is not None for r in self.slot_req)
+                + self.pending.qsize()
+                + len(self._holdback)
+            )
 
     def abort_all(self, reason: str = "abort") -> int:
         """Finish every in-flight request immediately (weight update /
-        shutdown). Returns how many were aborted."""
+        shutdown). Returns how many were aborted.
+
+        Each abort-freed slot gets a short reservation
+        (`abort_reserve_s`): the aborted client WILL resubmit with the
+        same prompt + accumulated tokens within an RTT, and handing the
+        slot to a fresh prompt first would overwrite the retained prefix
+        exactly when it is most valuable (the r4 abort-storm thrash)."""
         n = 0
+        deadline = time.monotonic() + self.abort_reserve_s
         with self._lock:
             for s, req in enumerate(self.slot_req):
                 if req is not None:
@@ -359,7 +393,20 @@ class GenEngine:
                     self.retained_len[s] = (
                         0 if self._slot_vlm[s] else self.lengths[s]
                     )
+                    # reserve only prefixes the owner's resubmission can
+                    # actually claim (its lcp == retained_len must clear
+                    # the reuse threshold) — a shorter prefix would park
+                    # the slot for a match the admission filter forbids
+                    if (
+                        self.kv_reuse
+                        and self.retained_len[s] >= self.reuse_min_tokens
+                    ):
+                        self._reserved_until[s] = deadline
                     n += 1
+            for req in self._holdback:
+                req.finish(reason)
+                n += 1
+            self._holdback = []
             while True:
                 try:
                     self.pending.get_nowait().finish(reason)
@@ -427,6 +474,7 @@ class GenEngine:
             # strict mode applies to EVERY weight-swap path: retained
             # prefixes hold old-policy KV and must not seed suffix prefills
             self.retained_len[:] = 0
+            self._reserved_until[:] = 0.0  # nothing left to reserve
         if getattr(self, "_standby", None) is not None:
             staged_v = self._standby[1]
             if staged_v is None or staged_v <= self.version:
@@ -515,6 +563,7 @@ class GenEngine:
         self.cache = None
         self._standby = None
         self.retained_len[:] = 0  # cache is gone; no prefix survives
+        self._reserved_until[:] = 0.0
         if drop_params:
             if isinstance(self.params, dict) and "vision" in self.params:
                 self.params = {"vision": self.params["vision"]}
@@ -577,26 +626,19 @@ class GenEngine:
     # stepping
     # ------------------------------------------------------------------
 
-    def _best_reuse_slot(self, ids: np.ndarray, free: List[int]) -> tuple:
-        """(slot, lcp) of the free slot whose retained cache shares the
-        longest common prefix with `ids`, or (-1, 0).  lcp is capped at
-        len(ids) - 1 so at least one suffix token runs through prefill
-        (its last-position logits seed sampling)."""
-        best_s, best_l = -1, 0
+    def _slot_lcps(self, ids: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Longest common prefix of `ids` against each slot's retained
+        cache, vectorised over `slots`.  Capped at len(ids) - 1 so at least
+        one suffix token runs through prefill (its last-position logits
+        seed sampling)."""
         limit = len(ids) - 1
-        for s in free:
-            if self._slot_vlm[s]:
-                continue
-            m = min(int(self.retained_len[s]), limit)
-            if m <= best_l:
-                continue
-            neq = np.nonzero(self.seq_tokens[s, :m] != ids[:m])[0]
-            l = int(neq[0]) if neq.size else m
-            if l > best_l:
-                best_s, best_l = s, l
-        if best_l < self.reuse_min_tokens:
-            return -1, 0
-        return best_s, best_l
+        caps = np.minimum(self.retained_len[slots], limit)  # [f]
+        m = int(caps.max()) if caps.size else 0
+        if m <= 0:
+            return np.zeros(len(slots), np.int64)
+        neq = self.seq_tokens[slots][:, :m] != ids[:m]  # [f, m]
+        first = np.where(neq.any(axis=1), neq.argmax(axis=1), m)
+        return np.minimum(first, caps)
 
     def _admit(self) -> None:
         """Fill every free slot from the pending queue in ONE bucketed
@@ -608,18 +650,42 @@ class GenEngine:
 
         With kv_reuse, prompts whose prefix matches a freed slot's retained
         cache go through a SUFFIX prefill instead (forward_prefill_cached):
-        multi-turn turns and interruption resumes pay O(new tokens)."""
+        multi-turn turns and interruption resumes pay O(new tokens).
+
+        Abort-storm discipline (VERDICT r4 #3): a WINDOW of the pending
+        queue is drained and prefix-matched against every free slot
+        GLOBALLY (highest lcp wins) before any slot is handed to a fresh
+        prompt, and abort-reserved slots are withheld from fresh prompts
+        until their reservation lapses — so when N aborted clients race
+        back over few slots, the retained prefixes go to the requests that
+        can actually reuse them instead of to whoever arrived first."""
         free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
-        # fresh admissions consume the least-valuable retained caches first
-        free.sort(key=lambda s: int(self.retained_len[s]))
-        admitted: List[tuple] = []  # (slot, req)
-        reuse_admitted: List[tuple] = []  # (slot, req, lcp)
-        vlm_admitted: List[tuple] = []
-        while free:
+        if not free:
+            return
+        if self._parked_free is not None:
+            # a previous pass admitted nothing; until a reservation expires,
+            # a slot frees, or a new request arrives, rescanning would
+            # produce the same nothing
+            if (
+                not self.pending.qsize()
+                and time.monotonic() < self._parked_until
+                and frozenset(free) == self._parked_free
+            ):
+                return
+            self._parked_free = None
+        # intake: held-back requests first (FIFO across admission passes),
+        # then drain fresh submissions up to the scan window
+        intake = self._holdback
+        self._holdback = []
+        while len(intake) < self.admission_window:
             try:
-                req = self.pending.get_nowait()
+                intake.append(self.pending.get_nowait())
             except queue.Empty:
                 break
+        if not intake:
+            return
+        entries: List[tuple] = []  # (req, is_vlm) in arrival order
+        for req in intake:
             if req.pixel_values is not None:
                 if not self._vlm:
                     # "length" terminates the client's interruption loop;
@@ -635,16 +701,73 @@ class GenEngine:
                     req.finish("length")
                     logger.error(f"rejecting VLM request {req.rid}: {err}")
                     continue
-                vlm_admitted.append((free.pop(0), req))
+                entries.append((req, True))
+            else:
+                entries.append((req, False))
+
+        admitted: List[tuple] = []  # (slot, req)
+        reuse_admitted: List[tuple] = []  # (slot, req, lcp)
+        vlm_admitted: List[tuple] = []
+        free_set = set(free)
+        matched: set = set()
+        if self.kv_reuse:
+            # global matching: all (request, slot) lcp pairs, best first
+            cand_slots = np.asarray(
+                [
+                    s for s in free
+                    if not self._slot_vlm[s]
+                    and self.retained_len[s] >= self.reuse_min_tokens
+                ],
+                np.int64,
+            )
+            if cand_slots.size:
+                cands: List[tuple] = []
+                for i, (req, is_vlm) in enumerate(entries):
+                    if is_vlm:
+                        continue
+                    ids = np.asarray(req.input_ids, np.int32)
+                    lcps = self._slot_lcps(ids, cand_slots)
+                    for j in np.nonzero(lcps >= self.reuse_min_tokens)[0]:
+                        # ties broken by arrival order (i ascending)
+                        cands.append((-int(lcps[j]), i, int(cand_slots[j])))
+                cands.sort()
+                for negl, i, s in cands:
+                    if i in matched or s not in free_set:
+                        continue
+                    matched.add(i)
+                    free_set.remove(s)
+                    reuse_admitted.append((s, entries[i][0], -negl))
+
+        # fresh prompts take the remaining UNRESERVED slots, least-valuable
+        # retained cache first; reserved slots stay parked for their
+        # aborted owner's resubmission until the TTL lapses
+        now = time.monotonic()
+        open_slots = sorted(
+            (s for s in free_set if self._reserved_until[s] <= now),
+            key=lambda s: int(self.retained_len[s]),
+        )
+        leftover: List[GenRequest] = []
+        for i, (req, is_vlm) in enumerate(entries):
+            if i in matched:
                 continue
-            if self.kv_reuse:
-                ids = np.asarray(req.input_ids, np.int32)
-                s, lcp = self._best_reuse_slot(ids, free)
-                if s >= 0:
-                    free.remove(s)
-                    reuse_admitted.append((s, req, lcp))
-                    continue
-            admitted.append((free.pop(0), req))
+            if not open_slots:
+                leftover.append(req)
+                continue
+            if is_vlm:
+                vlm_admitted.append((open_slots.pop(0), req))
+            else:
+                admitted.append((open_slots.pop(0), req))
+        self._holdback = leftover
+        if leftover and not (admitted or reuse_admitted or vlm_admitted):
+            # everything parked behind reservations: arm the no-progress
+            # guard until the earliest one expires
+            expiries = [
+                float(self._reserved_until[s])
+                for s in free
+                if self._reserved_until[s] > now
+            ]
+            self._parked_free = frozenset(free)
+            self._parked_until = min(expiries) if expiries else now + 0.05
         if vlm_admitted:
             self._admit_vlm_batch(vlm_admitted)
         if reuse_admitted:
@@ -696,6 +819,7 @@ class GenEngine:
                 self.top_p[s] = req.top_p
                 self.top_k[s] = req.top_k
                 self.retained_len[s] = 0
+                self._reserved_until[s] = 0.0
                 self._slot_vlm[s] = False
                 n = len(req.input_ids)
                 self.seq_tokens[s, :n] = req.input_ids
@@ -757,6 +881,7 @@ class GenEngine:
                 self.top_p[s] = req.top_p
                 self.top_k[s] = req.top_k
                 self.retained_len[s] = 0
+                self._reserved_until[s] = 0.0
                 self.seq_tokens[s, :n_total] = req.input_ids
         for i, (s, req, _) in enumerate(reuse_admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
@@ -899,6 +1024,7 @@ class GenEngine:
                 # need the image context too — VLM slots never retain
                 self._slot_vlm[s] = True
                 self.retained_len[s] = 0
+                self._reserved_until[s] = 0.0
         for i, (s, req) in enumerate(vlm_admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
@@ -1042,7 +1168,11 @@ class GenEngine:
         for r in reqs:
             self.submit(r)
         while any(not r.stop_reason for r in reqs):
-            if self.step() == 0 and self.pending.qsize() == 0:
-                break
+            if self.step() == 0:
+                # queued work may be parked behind an abort reservation
+                # (holdback); only a genuinely idle engine is done
+                if self.active_count() == 0:
+                    break
+                time.sleep(0.001)
             time.sleep(0)
         return reqs
