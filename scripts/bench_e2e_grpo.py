@@ -394,6 +394,11 @@ def main():
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--modes", default="sync,async")
+    p.add_argument("--warmup", type=int, default=1,
+                   help="untimed leading steps; interrupt-publish runs want "
+                        "2 so the first post-publish abort storm (whose "
+                        "burst admission compiles NEW suffix-prefill "
+                        "signatures) stays outside the timed region")
     p.add_argument("--workflow", default="rlvr",
                    choices=["rlvr", "multi_turn"],
                    help="multi_turn = retry-until-correct agentic episodes "
@@ -527,11 +532,12 @@ def main():
                 result[mode] = run_mode_remote(
                     mode, actor, client, server_engine, meta, workflow,
                     dataset, args.batch_size, args.steps,
+                    warmup=args.warmup,
                 )
             else:
                 result[mode] = run_mode(
                     mode, actor, serving, workflow, dataset,
-                    args.batch_size, args.steps,
+                    args.batch_size, args.steps, warmup=args.warmup,
                     interrupt_publish=args.publish_mode == "interrupt",
                 )
         if "sync" in result and "async" in result:
