@@ -37,6 +37,11 @@ class ModelRequest:
     # + per-image patch grids, the AutoProcessor's output layout
     pixel_values: Optional[Any] = None  # np [N, patch_dim]
     image_grid_thw: Optional[Any] = None  # np [n_img, 3]
+    # group fan-out (gen/engine.py): GRPO siblings over one prompt share a
+    # group_id + expected size so the router keeps them on one replica and
+    # the engine clusters them for cross-slot KV prefix sharing
+    group_id: str = ""
+    group_n: int = 0
 
     def copy(self) -> "ModelRequest":
         return ModelRequest(
@@ -49,6 +54,8 @@ class ModelRequest:
             processor=self.processor,
             pixel_values=self.pixel_values,
             image_grid_thw=self.image_grid_thw,
+            group_id=self.group_id,
+            group_n=self.group_n,
         )
 
 

@@ -257,7 +257,10 @@ class RemoteInfEngine(InferenceEngine):
         if max_new <= 0:
             raise RuntimeError(f"max_new_tokens={max_new} must be positive")
 
-        addr = self._server_for_rid(req.rid)
+        # group-affinity: siblings of one GRPO group must share a replica
+        # so the engine can fan their common prefix KV out across slots;
+        # the group key (when declared) outranks the per-request rid
+        addr = self._server_for_rid(req.group_id or req.rid)
         start = time.perf_counter()
         out_tokens: List[int] = []
         out_logprobs: List[float] = []

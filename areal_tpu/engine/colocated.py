@@ -203,6 +203,8 @@ class ColocatedEngine:
             budget = g.max_new_tokens - len(accumulated)
             gr = GenRequest(
                 rid=req.rid,
+                group_id=req.group_id,
+                group_n=req.group_n,
                 input_ids=input_ids + accumulated,
                 max_new_tokens=budget,
                 min_new_tokens=min(g.min_new_tokens, budget),

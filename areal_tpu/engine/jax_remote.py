@@ -34,6 +34,9 @@ class JaxBackend:
         g = req.gconfig
         payload = {
             "rid": req.rid,
+            # group affinity + fan-out clustering hints (gen/engine.py)
+            "group_id": req.group_id,
+            "group_n": req.group_n,
             "input_ids": list(req.input_ids),
             "sampling_params": {
                 "max_new_tokens": g.max_new_tokens,

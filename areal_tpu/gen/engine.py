@@ -46,6 +46,21 @@ Design for XLA's static shapes:
   from fresh prompts until their aborted owner has had an RTT to
   resubmit — so a publish that aborts N in-flight requests over few slots
   no longer hands the retained prefixes to whoever arrives first.
+- **Group fan-out prefill** (ISSUE 2): GRPO samples every group as
+  `group_size` requests over the SAME prompt, and per-slot retained reuse
+  can serve at most one of them — the other G-1 used to pay a full
+  redundant prefill.  Admission now clusters its window by longest common
+  prefix (explicit `group_id` groups first, content-discovered clusters
+  second), prefills ONE representative per cluster, fans the computed
+  prefix K/V out to sibling slots with a batched device-side cache copy
+  (ops/kv_copy.py — bucketed lengths, no new compile signatures in steady
+  state), and suffix-prefills only each sibling's remainder.  When a free
+  slot's retained cache already covers the cluster prefix (multi-turn),
+  the representative rides THAT via suffix prefill and nobody recomputes
+  the prefix at all.  `seq_tokens`/`kv_version` bookkeeping make shared
+  prefixes compose with the live weight swap exactly like retained ones
+  (strict mode zeroes both).  This is the in-engine counterpart of
+  SGLang's RadixAttention / vLLM's shared PagedAttention blocks.
 """
 
 import queue
@@ -78,6 +93,15 @@ from areal_tpu.utils.datapack import round_up_to_bucket
 logger = logging.getLogger("gen.engine")
 
 
+def _lcp_ids(a: List[int], b: List[int]) -> int:
+    """Longest common prefix of two token lists (vectorised)."""
+    m = min(len(a), len(b))
+    if m == 0:
+        return 0
+    neq = np.asarray(a[:m], np.int64) != np.asarray(b[:m], np.int64)
+    return int(neq.argmax()) if neq.any() else m
+
+
 @dataclass
 class GenRequest:
     rid: str
@@ -92,6 +116,13 @@ class GenRequest:
     # the per-image (t, h, w) patch grids — the AutoProcessor wire format
     pixel_values: Optional["np.ndarray"] = None  # [N, patch_dim]
     image_grid_thw: Optional["np.ndarray"] = None  # [n_img, 3]
+    # group fan-out: siblings sampling the same prompt (a GRPO group) carry
+    # a shared affinity key + the expected group size, so admission can
+    # hold for the full group, cluster it in one window, and the router can
+    # keep the members on one replica (the KV prefix is only shareable
+    # within one engine's cache)
+    group_id: str = ""
+    group_n: int = 0
     # filled by the engine
     output_tokens: List[int] = field(default_factory=list)
     output_logprobs: List[float] = field(default_factory=list)
@@ -125,6 +156,10 @@ class GenEngine:
         retain_kv_on_reload: bool = True,
         abort_reserve_s: float = 1.0,
         admission_window: Optional[int] = None,
+        share_prefix: bool = True,
+        share_min_tokens: Optional[int] = None,
+        group_hold_s: float = 0.05,
+        match_window: Optional[int] = None,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -237,6 +272,21 @@ class GenEngine:
         # prefix-matches globally before handing any slot to a fresh prompt
         self.abort_reserve_s = abort_reserve_s
         self.admission_window = admission_window or max(64, 4 * n_slots)
+        # the lcp scan is O(window x slots x prefix); cap how much of the
+        # drain window it touches independently of the drain size so large
+        # slot grids do not pay the full quadratic host cost per pass
+        self.match_window = match_window or max(64, 2 * n_slots)
+        # cross-slot prefix sharing (group fan-out prefill)
+        self.share_prefix = share_prefix
+        self.share_min_tokens = (
+            share_min_tokens if share_min_tokens is not None
+            else reuse_min_tokens
+        )
+        self.group_hold_s = group_hold_s
+        self._group_first_seen: Dict[str, float] = {}
+        # bumped by abort_all so an _admit pass that raced it can tell its
+        # drained-but-unadmitted requests were already terminally finished
+        self._abort_gen = 0
         self._reserved_until = np.zeros(S, np.float64)
         self._holdback: List[GenRequest] = []  # drained but not yet admitted
         # no-progress guard: a pass that parked everything records the slot
@@ -245,12 +295,18 @@ class GenEngine:
         self._parked_free: Optional[frozenset] = None
         self._parked_until: float = 0.0
         self._slot_vlm = np.zeros(S, bool)  # VLM slots never reuse (mrope)
+        # weight version of the OLDEST K/V in each slot's valid prefix:
+        # retained and shared prefixes propagate it, so strict-version
+        # audits can prove no pre-swap KV seeds post-swap decoding
+        self.kv_version = np.zeros(S, np.int64)
         self.stats = {
             "prefill_calls": 0,
             "prefill_tokens": 0,  # real prompt tokens through fresh prefill
             "suffix_calls": 0,
             "suffix_tokens": 0,  # real tokens through suffix prefill
-            "reused_tokens": 0,  # cache-prefix tokens NOT recomputed
+            "reused_tokens": 0,  # retained-prefix tokens NOT recomputed
+            "shared_tokens": 0,  # cluster-prefix tokens fanned out, not recomputed
+            "copy_calls": 0,  # device-side cross-slot prefix copies
             "decode_calls": 0,
         }
 
@@ -269,10 +325,13 @@ class GenEngine:
             return tok, logp, cache
 
         def _suffix_prefill(
-            params, cache, ids, starts, slens, slot_ids, rng, temp, tp, tk
+            params, cache, ids, starts, slens, slot_ids, copy_src,
+            rng, temp, tp, tk, copy_block, key_window,
         ):
             logits, cache = forward_prefill_cached(
-                params, cfg, ids, starts, slens, cache, slot_ids
+                params, cfg, ids, starts, slens, cache, slot_ids,
+                copy_src=copy_src, copy_block=copy_block,
+                key_window=key_window,
             )
             tok, logp = sample_tokens(logits.astype(jnp.float32), rng, temp, tk, tp)
             return tok, logp, cache
@@ -300,7 +359,14 @@ class GenEngine:
             return out, cache
 
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
-        self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(1,))
+        # the suffix program carries the cross-slot prefix fan-out fused in
+        # (ops/kv_copy.py gather/scatter before the layer scan): copy_block
+        # is static and always from the prompt-bucket ladder, so compile
+        # count stays O(log^2 buckets x log slots), same family as
+        # admission — and a grouped pass costs no extra dispatch
+        self._suffix_prefill_fn = jax.jit(
+            _suffix_prefill, static_argnums=(11, 12), donate_argnums=(1,)
+        )
         self._decode_fn = jax.jit(_decode_chunk, static_argnums=(9,),
                                   donate_argnums=(1,))
         self._init_vlm()
@@ -364,6 +430,14 @@ class GenEngine:
             return
         self.pending.put(req)
 
+    def submit_batch(self, reqs: List[GenRequest]) -> None:
+        """Enqueue a whole group contiguously, so one admission window sees
+        every member and the cluster fan-out can share their prefix; the
+        group hold (`group_hold_s`) covers members that still straggle in
+        through separate submits."""
+        for req in reqs:
+            self.submit(req)
+
     def active_count(self) -> int:
         with self._lock:
             return (
@@ -384,6 +458,7 @@ class GenEngine:
         n = 0
         deadline = time.monotonic() + self.abort_reserve_s
         with self._lock:
+            self._abort_gen += 1  # a racing _admit must drop its leftovers
             for s, req in enumerate(self.slot_req):
                 if req is not None:
                     req.finish(reason)
@@ -394,12 +469,14 @@ class GenEngine:
                         0 if self._slot_vlm[s] else self.lengths[s]
                     )
                     # reserve only prefixes the owner's resubmission can
-                    # actually claim (its lcp == retained_len must clear
-                    # the reuse threshold) — a shorter prefix would park
-                    # the slot for a match the admission filter forbids
+                    # actually claim: its lcp is capped below len(ids) in
+                    # _slot_lcps, so at retained_len == reuse_min_tokens
+                    # the slot would sit reserved-yet-unclaimable for the
+                    # whole TTL — the threshold must be STRICTLY greater
+                    # (ADVICE r5)
                     if (
                         self.kv_reuse
-                        and self.retained_len[s] >= self.reuse_min_tokens
+                        and self.retained_len[s] > self.reuse_min_tokens
                     ):
                         self._reserved_until[s] = deadline
                     n += 1
@@ -472,9 +549,13 @@ class GenEngine:
         self.version = version if version is not None else self.version + 1
         if not self.retain_kv_on_reload:
             # strict mode applies to EVERY weight-swap path: retained
-            # prefixes hold old-policy KV and must not seed suffix prefills
+            # prefixes hold old-policy KV and must not seed suffix
+            # prefills.  Shared (fan-out) prefixes are zeroed exactly the
+            # same way — once a sibling's slot frees, its copied prefix IS
+            # a retained prefix, and kv_version tracks its true origin.
             self.retained_len[:] = 0
             self._reserved_until[:] = 0.0  # nothing left to reserve
+            self.kv_version[:] = self.version  # no pre-swap KV survives
         if getattr(self, "_standby", None) is not None:
             staged_v = self._standby[1]
             if staged_v is None or staged_v <= self.version:
@@ -564,6 +645,7 @@ class GenEngine:
         self._standby = None
         self.retained_len[:] = 0  # cache is gone; no prefix survives
         self._reserved_until[:] = 0.0
+        self.kv_version[:] = self.version
         if drop_params:
             if isinstance(self.params, dict) and "vision" in self.params:
                 self.params = {"vision": self.params["vision"]}
@@ -640,6 +722,116 @@ class GenEngine:
         first = np.where(neq.any(axis=1), neq.argmax(axis=1), m)
         return np.minimum(first, caps)
 
+    def _apply_group_hold(self, entries: List[tuple]):
+        """Park members of a declared group (`group_id` + `group_n`) until
+        the whole group shares one admission window — the cluster fan-out
+        can only share a prefix among co-resident requests.  The hold TTL
+        (`group_hold_s`) bounds the wait: a sibling that already finished
+        never resubmits, so partial groups must eventually admit.
+        Returns (entries, held, hold_deadlines)."""
+        if self.group_hold_s <= 0 or not any(
+            r.group_id and r.group_n > 1 and not v for r, v in entries
+        ):
+            return entries, [], []
+        now = time.monotonic()
+        counts: Dict[str, int] = {}
+        need: Dict[str, int] = {}
+        for req, is_vlm in entries:
+            if req.group_id and req.group_n > 1 and not is_vlm:
+                counts[req.group_id] = counts.get(req.group_id, 0) + 1
+                need[req.group_id] = max(
+                    need.get(req.group_id, 0), req.group_n
+                )
+        hold: set = set()
+        deadlines: List[float] = []
+        for gid, cnt in counts.items():
+            if cnt >= need[gid]:
+                self._group_first_seen.pop(gid, None)
+                continue
+            first = self._group_first_seen.setdefault(gid, now)
+            if now - first < self.group_hold_s:
+                hold.add(gid)
+                deadlines.append(first + self.group_hold_s)
+            else:  # TTL lapsed: admit the partial group
+                self._group_first_seen.pop(gid, None)
+        if not hold:
+            return entries, [], []
+        held = [r for r, v in entries if not v and r.group_id in hold]
+        entries = [
+            (r, v) for r, v in entries if v or r.group_id not in hold
+        ]
+        return entries, held, deadlines
+
+    def _plan_clusters(
+        self, entries: List[tuple], matched: set
+    ) -> List[dict]:
+        """Cluster the admission window by shared prompt prefix ->
+        [{"members": [entry idx], "share": tokens}].
+
+        Explicit groups (GRPO siblings carrying group_id) cluster by key in
+        O(window); the rest cluster content-based — sorted by a bounded
+        prefix key, then adjacent-lcp runs (lcp is an ultrametric, so the
+        min over any chain through a set equals the set's lcp).  The
+        shared span is capped at min(len) - 1 so every sibling still
+        suffix-prefills at least one token (its last-position logits seed
+        sampling); clusters whose span misses `share_min_tokens` dissolve.
+
+        Entries already matched to a retained slot never become siblings
+        (their own retained prefix is at least as long) but do serve as
+        representatives — the fallback path where the cluster prefix is
+        never recomputed at all."""
+        cand = [
+            i for i, (req, is_vlm) in enumerate(entries)
+            if not is_vlm and len(req.input_ids) > self.share_min_tokens
+        ]
+        if len(cand) < 2:
+            return []
+        by_gid: Dict[str, List[int]] = {}
+        rest: List[int] = []
+        for i in cand:
+            gid = entries[i][0].group_id
+            (by_gid.setdefault(gid, []) if gid else rest).append(i)
+        raw = [m for m in by_gid.values() if len(m) >= 2]
+        rest.extend(i for m in by_gid.values() if len(m) == 1 for i in m)
+        rest = rest[: self.match_window]  # bound the host-side sort/scan
+        if len(rest) >= 2:
+            rest.sort(key=lambda i: tuple(entries[i][0].input_ids[:64]))
+            run = [rest[0]]
+            run_share: Optional[int] = None
+            for prev, cur in zip(rest, rest[1:]):
+                l = _lcp_ids(
+                    entries[prev][0].input_ids, entries[cur][0].input_ids
+                )
+                tentative = l if run_share is None else min(run_share, l)
+                if tentative >= self.share_min_tokens:
+                    run.append(cur)
+                    run_share = tentative
+                else:
+                    if len(run) >= 2:
+                        raw.append(run)
+                    run = [cur]
+                    run_share = None
+            if len(run) >= 2:
+                raw.append(run)
+        clusters: List[dict] = []
+        for members in raw:
+            ids0 = entries[members[0]][0].input_ids
+            share = min(
+                _lcp_ids(ids0, entries[i][0].input_ids)
+                for i in members[1:]
+            )
+            share = min(
+                share,
+                min(len(entries[i][0].input_ids) for i in members) - 1,
+            )
+            # a cluster of only retained-matched members has nothing to fan
+            # out; require at least one potential sibling
+            if share >= self.share_min_tokens and any(
+                i not in matched for i in members
+            ):
+                clusters.append({"members": sorted(members), "share": share})
+        return clusters
+
     def _admit(self) -> None:
         """Fill every free slot from the pending queue in ONE bucketed
         prefill call.  Rows are padded to a power of two; padding rows
@@ -658,14 +850,24 @@ class GenEngine:
         prompt, and abort-reserved slots are withheld from fresh prompts
         until their reservation lapses — so when N aborted clients race
         back over few slots, the retained prefixes go to the requests that
-        can actually reuse them instead of to whoever arrived first."""
+        can actually reuse them instead of to whoever arrived first.
+
+        Group fan-out (ISSUE 2): remaining requests cluster by longest
+        common prefix; each cluster prefills one representative, fans its
+        prefix K/V out to sibling slots with a device-side cache copy, and
+        the siblings suffix-prefill only their remainder — a GRPO group of
+        G pays ~1/G of the old grouped prefill FLOPs.  Reservations keep
+        applying per SLOT to the abort-resubmission flow: each aborted
+        sibling reclaims its own retained slot through the global matching
+        above (its retained prefix is strictly longer than the cluster's),
+        so a storm never collapses a cluster onto one reserved slot."""
         free = [s for s in range(self.n_slots) if self.slot_req[s] is None]
         if not free:
             return
         if self._parked_free is not None:
             # a previous pass admitted nothing; until a reservation expires,
-            # a slot frees, or a new request arrives, rescanning would
-            # produce the same nothing
+            # a group hold lapses, a slot frees, or a new request arrives,
+            # rescanning would produce the same nothing
             if (
                 not self.pending.qsize()
                 and time.monotonic() < self._parked_until
@@ -674,9 +876,15 @@ class GenEngine:
                 return
             self._parked_free = None
         # intake: held-back requests first (FIFO across admission passes),
-        # then drain fresh submissions up to the scan window
-        intake = self._holdback
-        self._holdback = []
+        # then drain fresh submissions up to the scan window.  The holdback
+        # swap runs under the lock (ADVICE r5): a concurrent abort_all
+        # either sees these requests in _holdback and finishes them, or the
+        # generation counter tells this pass to drop its leftovers — never
+        # a resurrection after their terminal 'abort' callback.
+        with self._lock:
+            abort_gen = self._abort_gen
+            intake = self._holdback
+            self._holdback = []
         while len(intake) < self.admission_window:
             try:
                 intake.append(self.pending.get_nowait())
@@ -704,14 +912,28 @@ class GenEngine:
                 entries.append((req, True))
             else:
                 entries.append((req, False))
+        held: List[GenRequest] = []
+        group_deadlines: List[float] = []
+        if self.share_prefix:
+            entries, held, group_deadlines = self._apply_group_hold(entries)
 
         admitted: List[tuple] = []  # (slot, req)
-        reuse_admitted: List[tuple] = []  # (slot, req, lcp)
+        # suffix rows: (slot, req, start, kv_src, shared) — retained reuse
+        # and cluster fan-out ride ONE bucketed call (the fan-out copy is
+        # fused into the suffix program)
+        reuse_admitted: List[tuple] = []
         vlm_admitted: List[tuple] = []
+        shared_admitted: List[tuple] = []
         free_set = set(free)
         matched: set = set()
+        slot_of_entry: Dict[int, tuple] = {}  # entry idx -> (slot, lcp)
         if self.kv_reuse:
-            # global matching: all (request, slot) lcp pairs, best first
+            # global matching: all (request, slot) lcp pairs, best first.
+            # Short-circuit when no free slot retains a reusable prefix
+            # (the common steady state) — the O(window x slots x prefix)
+            # numpy scan below is only worth paying when a match can exist
+            # (ADVICE r5); the scanned window is capped at match_window
+            # independently of the drain window.
             cand_slots = np.asarray(
                 [
                     s for s in free
@@ -722,7 +944,9 @@ class GenEngine:
             )
             if cand_slots.size:
                 cands: List[tuple] = []
-                for i, (req, is_vlm) in enumerate(entries):
+                for i, (req, is_vlm) in enumerate(
+                    entries[: self.match_window]
+                ):
                     if is_vlm:
                         continue
                     ids = np.asarray(req.input_ids, np.int32)
@@ -736,7 +960,26 @@ class GenEngine:
                         continue
                     matched.add(i)
                     free_set.remove(s)
-                    reuse_admitted.append((s, entries[i][0], -negl))
+                    slot_of_entry[i] = (s, -negl)
+                    reuse_admitted.append((s, entries[i][0], -negl, s, False))
+
+        clusters: List[dict] = (
+            self._plan_clusters(entries, matched) if self.share_prefix else []
+        )
+        cluster_of: Dict[int, int] = {}
+        for cid, cl in enumerate(clusters):
+            for i in cl["members"]:
+                cluster_of[i] = cid
+                # a retained-matched member is the preferred representative
+                # — the fallback path where NOBODY recomputes the cluster
+                # prefix (multi-turn branch points).  The share is capped
+                # at its retained lcp: that span is valid in its row BEFORE
+                # the suffix batch runs, so the fused fan-out copy and the
+                # representative's own suffix can share one dispatch.
+                if "rep_slot" not in cl and i in slot_of_entry:
+                    s, lcp = slot_of_entry[i]
+                    cl["rep_slot"] = s
+                    cl["share"] = min(cl["share"], lcp)
 
         # fresh prompts take the remaining UNRESERVED slots, least-valuable
         # retained cache first; reserved slots stay parked for their
@@ -746,34 +989,71 @@ class GenEngine:
             (s for s in free_set if self._reserved_until[s] <= now),
             key=lambda s: int(self.retained_len[s]),
         )
-        leftover: List[GenRequest] = []
+        leftover: List[GenRequest] = list(held)
         for i, (req, is_vlm) in enumerate(entries):
             if i in matched:
                 continue
             if not open_slots:
                 leftover.append(req)
+                if req.group_id:
+                    # the group already had its co-resident window; a later
+                    # pass must admit the leftover members immediately (they
+                    # still content-cluster among themselves) instead of
+                    # re-parking them for the hold TTL
+                    self._group_first_seen[req.group_id] = 0.0
                 continue
-            if is_vlm:
-                vlm_admitted.append((open_slots.pop(0), req))
+            s = open_slots.pop(0)
+            cid = cluster_of.get(i)
+            if cid is not None and clusters[cid].get("rep_slot") is not None:
+                shared_admitted.append(
+                    (s, req, clusters[cid]["share"],
+                     clusters[cid]["rep_slot"], True)
+                )
+            elif is_vlm:
+                vlm_admitted.append((s, req))
             else:
-                admitted.append((open_slots.pop(0), req))
-        self._holdback = leftover
-        if leftover and not (admitted or reuse_admitted or vlm_admitted):
-            # everything parked behind reservations: arm the no-progress
-            # guard until the earliest one expires
+                admitted.append((s, req))
+                if cid is not None:
+                    # first member to land a slot becomes the cluster's
+                    # representative; later members fan out from it
+                    clusters[cid]["rep_slot"] = s
+        with self._lock:
+            if self._abort_gen != abort_gen:
+                # an abort_all landed mid-pass and already finished every
+                # request it could see; the ones we drained would otherwise
+                # be resurrected behind their terminal callback
+                for req in leftover:
+                    req.finish("abort")
+                leftover = []
+            else:
+                self._holdback = leftover
+        if leftover and not (
+            admitted or reuse_admitted or vlm_admitted or shared_admitted
+        ):
+            # everything parked behind reservations or a group hold: arm
+            # the no-progress guard until the earliest one expires
             expiries = [
                 float(self._reserved_until[s])
                 for s in free
                 if self._reserved_until[s] > now
-            ]
+            ] + group_deadlines
             self._parked_free = frozenset(free)
             self._parked_until = min(expiries) if expiries else now + 0.05
         if vlm_admitted:
             self._admit_vlm_batch(vlm_admitted)
-        if reuse_admitted:
-            self._admit_suffix_batch(reuse_admitted)
-        if not admitted:
-            return
+        if admitted:
+            self._admit_fresh_batch(admitted)
+        if reuse_admitted or shared_admitted:
+            # one suffix call for retained reuse AND cluster siblings: by
+            # now every copy source row holds its cluster prefix (fresh
+            # representatives prefilled above; retained representatives'
+            # shares were capped at their already-valid lcp), so the fused
+            # fan-out copy inside the program reads only settled K/V
+            self._admit_suffix_batch(reuse_admitted + shared_admitted)
+
+    def _admit_fresh_batch(self, admitted: List[tuple]) -> None:
+        """Full prefill for prompts with no reusable prefix anywhere: ONE
+        bucketed forward_prefill call (pow2 rows, scratch-slot padding)."""
         bucket = round_up_to_bucket(
             max(max(len(r.input_ids) for _, r in admitted), 1),
             self.prompt_bucket,
@@ -821,38 +1101,70 @@ class GenEngine:
                 self.retained_len[s] = 0
                 self._reserved_until[s] = 0.0
                 self._slot_vlm[s] = False
+                self.kv_version[s] = self.version
                 n = len(req.input_ids)
                 self.seq_tokens[s, :n] = req.input_ids
         for i, (s, req) in enumerate(admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
-    def _admit_suffix_batch(self, reuse_admitted: List[tuple]) -> None:
-        """Suffix-only prefill into slots whose retained cache already holds
-        the prompt's prefix: ONE bucketed forward_prefill_cached call, same
-        O(log) compiled-program discipline as fresh admission."""
+    def _admit_suffix_batch(self, batch: List[tuple]) -> None:
+        """Suffix-only prefill into slots whose cache (about to) hold the
+        prompt's prefix: ONE bucketed forward_prefill_cached call, same
+        O(log) compiled-program discipline as fresh admission.
+
+        `batch` rows are (slot, req, start, kv_src, shared): `start` counts
+        prompt tokens the row inherits rather than recomputes — the
+        retained lcp, or the cluster's shared span — and `kv_src` is the
+        slot whose cache computed them (the slot itself for retained
+        reuse, the cluster representative for fan-out siblings).  Shared
+        rows get their prefix K/V via the copy FUSED into the suffix
+        program (ops/kv_copy.py; retained rows self-copy as identity), so
+        retained reuse and group fan-out cost one dispatch together.
+        kv_src's kv_version propagates so strict-version audits stay
+        exact; `shared` picks the stat bucket for the skipped tokens."""
         bucket = round_up_to_bucket(
-            max(len(r.input_ids) - lcp for _, r, lcp in reuse_admitted),
+            max(len(r.input_ids) - start for _, r, start, _, _ in batch),
             self.prompt_bucket,
             self.max_seq_len,
         )
-        S = 1 << (len(reuse_admitted) - 1).bit_length()
+        S = 1 << (len(batch) - 1).bit_length()
         ids = np.zeros((S, bucket), np.int32)
         starts = np.zeros(S, np.int32)
         slens = np.ones(S, np.int32)
         slot_ids = np.full(S, self.n_slots, np.int32)
+        copy_src = np.full(S, self.n_slots, np.int32)  # pad: scratch
         temp = np.ones(S, np.float32)
         top_p = np.ones(S, np.float32)
         top_k = np.zeros(S, np.int32)
-        for i, (s, req, lcp) in enumerate(reuse_admitted):
-            suffix = req.input_ids[lcp:]
+        max_shared = 0
+        for i, (s, req, start, kv_src, shared) in enumerate(batch):
+            suffix = req.input_ids[start:]
             n = len(suffix)
             ids[i, :n] = suffix
-            starts[i] = lcp
+            starts[i] = start
             slens[i] = n
             slot_ids[i] = s
+            copy_src[i] = kv_src
             temp[i] = req.temperature
             top_p[i] = req.top_p
             top_k[i] = req.top_k
+            if shared:
+                max_shared = max(max_shared, start)
+        # bucketed fan-out span; 0 (no shared rows) skips the copy and
+        # compiles the same retained-only program as before
+        copy_block = (
+            round_up_to_bucket(max_shared, self.prompt_bucket,
+                               self.max_seq_len)
+            if max_shared else 0
+        )
+        # bucketed attended span: attention reads O(P x key_window), not
+        # O(P x max_seq_len) — short sequences in a deep cache stop paying
+        # for the whole row
+        key_window = round_up_to_bucket(
+            int((starts[: len(batch)] + slens[: len(batch)]).max()),
+            self.prompt_bucket,
+            self.max_seq_len,
+        )
         self.rng, sub = jax.random.split(self.rng)
         toks, logps, self.cache = self._suffix_prefill_fn(
             self.params,
@@ -861,17 +1173,25 @@ class GenEngine:
             jnp.asarray(starts),
             jnp.asarray(slens),
             jnp.asarray(slot_ids),
+            jnp.asarray(copy_src),
             sub,
             jnp.asarray(temp),
             jnp.asarray(top_p),
             jnp.asarray(top_k),
+            copy_block,
+            key_window,
         )
         toks, logps = np.asarray(toks), np.asarray(logps)
         self.stats["suffix_calls"] += 1
-        self.stats["suffix_tokens"] += int(slens[: len(reuse_admitted)].sum())
-        self.stats["reused_tokens"] += int(starts[: len(reuse_admitted)].sum())
+        if copy_block:
+            self.stats["copy_calls"] += 1
+        self.stats["suffix_tokens"] += int(slens[: len(batch)].sum())
+        for i, (_, _, start, _, shared) in enumerate(batch):
+            self.stats["shared_tokens" if shared else "reused_tokens"] += (
+                int(start)
+            )
         with self._lock:
-            for i, (s, req, lcp) in enumerate(reuse_admitted):
+            for i, (s, req, start, kv_src, _) in enumerate(batch):
                 n_total = len(req.input_ids)
                 self.slot_req[s] = req
                 self.lengths[s] = n_total
@@ -882,8 +1202,13 @@ class GenEngine:
                 self.top_k[s] = req.top_k
                 self.retained_len[s] = 0
                 self._reserved_until[s] = 0.0
+                # oldest KV in the slot: the inherited prefix's version
+                # (suffix tokens are current-version by construction)
+                self.kv_version[s] = min(
+                    int(self.kv_version[kv_src]), self.version
+                )
                 self.seq_tokens[s, :n_total] = req.input_ids
-        for i, (s, req, _) in enumerate(reuse_admitted):
+        for i, (s, req, _, _, _) in enumerate(batch):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
     def _validate_vlm_request(self, req: GenRequest) -> Optional[str]:
@@ -1025,6 +1350,7 @@ class GenEngine:
                 self._slot_vlm[s] = True
                 self.retained_len[s] = 0
                 self._reserved_until[s] = 0.0
+                self.kv_version[s] = self.version
         for i, (s, req) in enumerate(vlm_admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 

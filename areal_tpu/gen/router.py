@@ -142,7 +142,11 @@ class Router:
 
     async def generate(self, request: web.Request) -> web.Response:
         body = await request.json()
-        rid = body.get("rid", "")
+        # group members must land on ONE replica: the KV prefix is only
+        # shareable within a single engine's cache, so the affinity key is
+        # the group when one is declared, the rid otherwise (interruption
+        # resubmits keep riding the same key either way)
+        rid = body.get("group_id") or body.get("rid", "")
         # _tokens tracks tokens currently resident on each backend (a proxy
         # for live KV usage, the reference's least_token_usage signal) — NOT
         # a cumulative history, so finished requests free their share
@@ -161,6 +165,34 @@ class Router:
         finally:
             async with self._lock:
                 self._inflight[addr] = self._inflight.get(addr, 1) - 1
+                self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_prompt)
+        return web.json_response(payload, status=status)
+
+    async def generate_batch(self, request: web.Request) -> web.Response:
+        """Route a whole group to ONE backend in one POST (the batch-submit
+        path that guarantees co-resident admission for the engine's group
+        fan-out).  Affinity key: the first member's group_id/rid."""
+        body = await request.json()
+        reqs = body.get("requests", [])
+        if not reqs:
+            return web.json_response({"error": "empty batch"}, status=400)
+        first = reqs[0]
+        key = first.get("group_id") or first.get("rid", "")
+        n_prompt = sum(len(r.get("input_ids", ())) for r in reqs)
+        async with self._lock:
+            addr = self._server_for_rid(key)
+            self._inflight[addr] = self._inflight.get(addr, 0) + len(reqs)
+            self._routed[addr] = self._routed.get(addr, 0) + len(reqs)
+            self._tokens[addr] = self._tokens.get(addr, 0) + n_prompt
+        try:
+            async with self._session.post(
+                f"http://{addr}/generate_batch", json=body
+            ) as resp:
+                payload = await resp.json()
+                status = resp.status
+        finally:
+            async with self._lock:
+                self._inflight[addr] = self._inflight.get(addr, len(reqs)) - len(reqs)
                 self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_prompt)
         return web.json_response(payload, status=status)
 
@@ -425,6 +457,7 @@ class Router:
     def app(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
         app.router.add_post("/generate", self.generate)
+        app.router.add_post("/generate_batch", self.generate_batch)
         app.router.add_post("/allocate_request", self.allocate_request)
         app.router.add_post("/finish_request", self.finish_request)
         app.router.add_post("/update_weights", self.update_weights)

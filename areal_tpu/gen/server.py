@@ -113,15 +113,11 @@ class GenServer:
 
     # ----------------------------- handlers -----------------------------
 
-    async def generate(self, request: web.Request) -> web.Response:
-        body = await request.json()
+    @staticmethod
+    def _req_from_body(body: dict, on_done) -> GenRequest:
+        """Wire body -> GenRequest (shared by /generate and
+        /generate_batch)."""
         sp = body.get("sampling_params", {})
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-
-        def on_done(r: GenRequest):
-            loop.call_soon_threadsafe(fut.set_result, r)
-
         pixel_values = None
         image_grid_thw = None
         if body.get("pixel_values_b64"):
@@ -129,8 +125,10 @@ class GenServer:
                 base64.b64decode(body["pixel_values_b64"]), dtype=np.float32
             ).reshape(body["pixel_values_shape"])
             image_grid_thw = np.asarray(body["image_grid_thw"], np.int64)
-        req = GenRequest(
+        return GenRequest(
             rid=body.get("rid", ""),
+            group_id=str(body.get("group_id", "") or ""),
+            group_n=int(body.get("group_n", 0) or 0),
             input_ids=[int(t) for t in body["input_ids"]],
             max_new_tokens=int(sp.get("max_new_tokens", 256)),
             min_new_tokens=int(sp.get("min_new_tokens", 0)),
@@ -142,16 +140,57 @@ class GenServer:
             image_grid_thw=image_grid_thw,
             on_done=on_done,
         )
-        self.engine.submit(req)
+
+    @staticmethod
+    def _result_payload(r: GenRequest, version: int) -> dict:
+        return {
+            "output_tokens": r.output_tokens,
+            "output_logprobs": r.output_logprobs,
+            "output_versions": r.output_versions,
+            "stop_reason": r.stop_reason or "stop",
+            "version": version,
+        }
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def on_done(r: GenRequest):
+            loop.call_soon_threadsafe(fut.set_result, r)
+
+        self.engine.submit(self._req_from_body(body, on_done))
         r: GenRequest = await fut
+        return web.json_response(self._result_payload(r, self.engine.version))
+
+    async def generate_batch(self, request: web.Request) -> web.Response:
+        """Submit a whole group in one POST ({"requests": [...]}) so every
+        member lands in one admission window and the engine's cluster
+        fan-out shares their common prefix (GRPO groups: one prefill +
+        fan-out instead of group_size prefills).  Responds with
+        {"results": [...]} in request order once ALL members finish."""
+        body = await request.json()
+        reqs_in = body.get("requests", [])
+        if not reqs_in:
+            return web.json_response({"error": "empty batch"}, status=400)
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in reqs_in]
+
+        def make_done(fut):
+            def on_done(r: GenRequest):
+                loop.call_soon_threadsafe(fut.set_result, r)
+
+            return on_done
+
+        reqs = [
+            self._req_from_body(b, make_done(f))
+            for b, f in zip(reqs_in, futs)
+        ]
+        self.engine.submit_batch(reqs)
+        done = await asyncio.gather(*futs)
+        version = self.engine.version
         return web.json_response(
-            {
-                "output_tokens": r.output_tokens,
-                "output_logprobs": r.output_logprobs,
-                "output_versions": r.output_versions,
-                "stop_reason": r.stop_reason or "stop",
-                "version": self.engine.version,
-            }
+            {"results": [self._result_payload(r, version) for r in done]}
         )
 
     async def pause(self, request: web.Request) -> web.Response:
@@ -337,6 +376,13 @@ class GenServer:
                 # achieved generation-idle window of the last weight swap
                 "last_pause_s": round(self.engine.last_pause_s, 4),
                 "staged": self.engine.has_standby,
+                # prefill-side token accounting: cold vs retained-reuse vs
+                # group fan-out (shared) — the grouped-prefill savings
+                "prefill_tokens": self.engine.stats["prefill_tokens"],
+                "suffix_tokens": self.engine.stats["suffix_tokens"],
+                "reused_tokens": self.engine.stats["reused_tokens"],
+                "shared_tokens": self.engine.stats["shared_tokens"],
+                "copy_calls": self.engine.stats["copy_calls"],
             }
         )
 
@@ -345,6 +391,7 @@ class GenServer:
     def app(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
         app.router.add_post("/generate", self.generate)
+        app.router.add_post("/generate_batch", self.generate_batch)
         app.router.add_post("/pause_generation", self.pause)
         app.router.add_post("/continue_generation", self.resume)
         app.router.add_post("/update_weights_from_disk", self.update_weights_from_disk)

@@ -586,6 +586,9 @@ def forward_prefill_cached(
     suffix_lens: jax.Array,  # int32 [S]: real suffix tokens per row
     cache: Dict[str, jax.Array],
     slot_ids: jax.Array,  # int32 [S]
+    copy_src: Optional[jax.Array] = None,  # int32 [S]: prefix-KV source row
+    copy_block: int = 0,  # STATIC bucketed copy length (0 = no fan-out)
+    key_window: Optional[int] = None,  # STATIC bucketed attended span
 ):
     """Prefill only a SUFFIX of each row, attending over the slot's retained
     KV prefix [0, starts) plus the causal suffix — the engine's KV prefix
@@ -593,17 +596,36 @@ def forward_prefill_cached(
     reference gets from SGLang, areal/core/remote_inf_engine.py:404-413).
     Returns (last-token logits [S, V], updated cache).
 
-    Cost is O(P * M) attention over the cache row instead of O(P^2) within
-    the prompt — the right trade when P (new tokens) << the retained
-    prefix.  Fresh admissions keep using `forward_prefill`."""
+    Group fan-out (ISSUE 2): with `copy_src`/`copy_block`, each row's
+    prefix K/V [0, copy_block) is first copied from `copy_src[row]` into
+    its own slot — ONE batched gather/scatter over the cache pytree
+    (ops/kv_copy.py) fused into the same program, so GRPO siblings ride
+    their representative's prefix without an extra dispatch.  Rows that
+    reuse their OWN retained prefix pass copy_src == slot_ids (an identity
+    self-copy); copy_block rides the prompt-bucket ladder so the program
+    count stays bounded.  The caller guarantees every source row's
+    [0, starts[row]) span is valid BEFORE this call (fresh representatives
+    prefill first; retained representatives cap the share at their lcp).
+
+    Cost is O(P * K) attention over the attended span K (`key_window`, a
+    bucketed bound on the deepest row's start + suffix — M when omitted)
+    instead of O(P^2) within the prompt — the right trade when P (new
+    tokens) << the retained prefix, and the window keeps short sequences
+    in a large cache from paying O(M).  Fresh admissions keep using
+    `forward_prefill`."""
     S, P = input_ids.shape
     M = cache["k"].shape[2]
+    if copy_block and copy_src is not None:
+        from areal_tpu.ops.kv_copy import copy_kv_prefix
+
+        cache = copy_kv_prefix(cache, copy_src, slot_ids, copy_block)
+    K = min(key_window, M) if key_window else M
     dtype = jnp.dtype(cfg.dtype)
     offs = jnp.arange(P, dtype=jnp.int32)
     positions = starts[:, None] + offs[None, :]  # [S, P] global positions
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
     x = _embed(params, cfg, input_ids, dtype, positions=positions)
-    key_pos = jnp.arange(M, dtype=jnp.int32)
+    key_pos = jnp.arange(K, dtype=jnp.int32)
     # q at global position g attends cache positions <= g; padding rows
     # (offs >= suffix_lens) produce garbage that is never read
     per_layer_window = (
@@ -630,8 +652,11 @@ def forward_prefill_cached(
             k = apply_rope(k, cos, sin)
         ck = ck.at[slot_ids[:, None], positions].set(k.astype(ck.dtype))
         cv = cv.at[slot_ids[:, None], positions].set(v.astype(cv.dtype))
-        ckr = jnp.take(ck, slot_ids, axis=0).astype(dtype)  # [S, M, Hkv, hd]
-        cvr = jnp.take(cv, slot_ids, axis=0).astype(dtype)
+        # gather only the attended span [0, K) of each row — the cache
+        # write above stays full-range, but attention never reads past the
+        # window the caller bounded
+        ckr = jnp.take(ck, slot_ids, axis=0)[:, :K].astype(dtype)
+        cvr = jnp.take(cv, slot_ids, axis=0)[:, :K].astype(dtype)
         attn = attention(q, ckr, cvr, m, cfg.attn_logit_softcap)
         delta = _proj(
             cfg, lp["attn"], "wo", attn.reshape(S, P, cfg.q_size), dtype,

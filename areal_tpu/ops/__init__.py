@@ -12,6 +12,7 @@ from areal_tpu.ops.functional import (
     sft_loss_fn,
 )
 from areal_tpu.ops.gae import gae_padded, gae_segments
+from areal_tpu.ops.kv_copy import copy_kv_prefix
 
 __all__ = [
     "gather_logprobs",
@@ -27,4 +28,5 @@ __all__ = [
     "masked_normalize",
     "gae_padded",
     "gae_segments",
+    "copy_kv_prefix",
 ]

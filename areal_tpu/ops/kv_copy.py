@@ -1,0 +1,59 @@
+"""Cross-slot KV prefix fan-out for grouped admission.
+
+GRPO samples every group as `group_size` requests over the SAME prompt, and
+tree-search / multi-turn branches share a transcript prefix.  The engine
+prefills one representative per prefix-cluster and then *copies* the computed
+prefix K/V from the representative's cache row into every sibling slot —
+one batched gather/scatter over the cache pytree for ALL clusters in the
+admission pass, entirely on device — so siblings prefill only their
+per-request suffix.
+
+Shape discipline (the same O(log) compiled-program budget as admission):
+
+- `block` (copied positions) is STATIC and always comes from the engine's
+  prompt-bucket ladder (`round_up_to_bucket`), so copy programs share the
+  prefill buckets' signature family instead of minting one per prefix
+  length.
+- `src_slots`/`dst_slots` are padded to a power of two with the scratch
+  slot (a scratch->scratch self-copy is a harmless no-op), so destination
+  counts bucket the same way admission rows do.
+- Every cluster in a pass whose prefix shares a block bucket rides ONE
+  call: `src_slots[i]` is destination i's own representative, so a pass
+  admitting eight groups costs one dispatch, not eight.
+
+Copying a full `block >= prefix_len` is safe without masking: positions in
+`[prefix_len, block)` hold the representative's (or stale) K/V, but every
+consumer overwrites them before they can be attended — the sibling's suffix
+prefill writes `[prefix_len, prefix_len + P)` before its queries run, and
+decode writes position `lengths` each step before attending `<= lengths`
+(the same frontier invariant padded suffix rows already rely on).
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def copy_kv_prefix(
+    cache: Dict[str, jax.Array],
+    src_slots: jax.Array,  # int32 [d]: source cache row per destination
+    dst_slots: jax.Array,  # int32 [d]: sibling rows (scratch-padded pow2)
+    block: int,  # STATIC bucketed prefix length (positions copied)
+) -> Dict[str, jax.Array]:
+    """Copy cache positions [0, block) of `src_slots[i]` into
+    `dst_slots[i]` for every layer; returns the updated cache pytree.
+
+    Cache layout is [L, S, M, Hkv, hd] (models/transformer.py
+    init_kv_cache).  The source rows gather once ([L, d, block, Hkv, hd])
+    and scatter to the destinations in one pass — jitted by the engine
+    with the cache donated, this lowers to a gather + one
+    dynamic-update-slice-style scatter without any host round-trip.
+    """
+    out = {}
+    for key, buf in cache.items():
+        blk = buf[:, src_slots, :block]  # [L, d, block, Hkv, hd]
+        # scratch-padded rows self-copy identical values, so the scatter
+        # stays deterministic even with duplicate pad indices
+        out[key] = buf.at[:, dst_slots, :block].set(blk)
+    return out

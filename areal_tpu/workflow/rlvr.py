@@ -82,8 +82,17 @@ class RLVRWorkflow(RolloutWorkflow):
     async def arun_episode(self, engine, data: Dict[str, Any]):
         n = self.gconfig.n_samples
         req = self._build_request(data)
+        reqs = [req.copy() for _ in range(n)]
+        if n > 1:
+            # GRPO group: declare the siblings so routing keeps them on one
+            # replica and the engine admits them as one prefix-sharing
+            # cluster (one prefill + KV fan-out instead of n prefills)
+            for k, r in enumerate(reqs):
+                r.rid = f"{req.rid}-{k}"
+                r.group_id = req.rid
+                r.group_n = n
         resps = await asyncio.gather(
-            *[engine.agenerate(req.copy()) for _ in range(n)]
+            *[engine.agenerate(r) for r in reqs]
         )
         results = []
         for resp in resps:

@@ -63,7 +63,7 @@ class _FakeTokenizer:
 
 def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
                 group_size: int, batch_norm: bool = False,
-                serving_engine: bool = True):
+                serving_engine: bool = True, share_prefix: bool = True):
     import jax
 
     from areal_tpu.api.config import (
@@ -131,6 +131,7 @@ def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
         max_seq_len=max_seq_len,
         prompt_bucket=128,
         decode_chunk=8,
+        share_prefix=share_prefix,
     )
     return actor, serving, cfg
 
@@ -160,6 +161,7 @@ def _make_remote_parts(args, actor, cfg):
         max_seq_len=args.max_seq_len,
         prompt_bucket=128,
         decode_chunk=8,
+        share_prefix=args.share_prefix == "on",
     )
     server = GenServer(engine)
     server.start()
@@ -413,6 +415,9 @@ def main():
                    help="live = non-aborting swap_weights_live (the "
                         "default everywhere since r5); interrupt = "
                         "abort-and-resume for A/B comparison")
+    p.add_argument("--share-prefix", default="on", choices=["on", "off"],
+                   help="off = pre-fan-out admission (per-slot retained "
+                        "reuse only) for A/B regression runs")
     p.add_argument("--transport", default="colocated",
                    choices=["colocated", "remote"],
                    help="colocated = in-process ColocatedEngine handoff; "
@@ -441,6 +446,7 @@ def main():
         args.model, args.n_slots, args.max_seq_len, args.group_size,
         batch_norm=args.workflow == "multi_turn",
         serving_engine=args.transport == "colocated",
+        share_prefix=args.share_prefix == "on",
     )
     client = server_engine = stop_server = meta = None
     if args.transport == "remote":
@@ -523,6 +529,7 @@ def main():
         "max_new_tokens": args.max_new_tokens,
         "len_jitter": args.len_jitter,
         "publish_mode": args.publish_mode,
+        "share_prefix": args.share_prefix,
         "warm_shapes": [list(s) for s in shapes],
         "warm_s": warm_s,
     }
@@ -545,20 +552,31 @@ def main():
                 result["async"]["trajs_per_sec_per_chip"]
                 / result["sync"]["trajs_per_sec_per_chip"], 3,
             )
+        st = (server_engine if args.transport == "remote"
+              else serving.engine).stats
+        total_prefill = (st["prefill_tokens"] + st["suffix_tokens"]
+                         + st["reused_tokens"] + st["shared_tokens"])
         if args.workflow == "multi_turn":
             # later turns re-prefill only the suffix when the engine still
             # holds the episode's KV prefix (gen/engine.py _slot_lcps)
-            st = (server_engine if args.transport == "remote"
-                  else serving.engine).stats
-            total_prefill = st["prefill_tokens"] + st["suffix_tokens"] + st[
-                "reused_tokens"
-            ]
             result["kv_reuse"] = {
                 "prefill_tokens": int(st["prefill_tokens"]),
                 "suffix_tokens": int(st["suffix_tokens"]),
                 "reused_tokens": int(st["reused_tokens"]),
                 "reused_fraction": round(
                     st["reused_tokens"] / max(total_prefill, 1), 3
+                ),
+            }
+        if args.group_size > 1:
+            # group fan-out prefill: siblings of each GRPO group ride the
+            # representative's prefix KV (gen/engine.py cluster fan-out)
+            result["shared_prefill"] = {
+                "prefill_tokens": int(st["prefill_tokens"]),
+                "suffix_tokens": int(st["suffix_tokens"]),
+                "shared_tokens": int(st["shared_tokens"]),
+                "copy_calls": int(st["copy_calls"]),
+                "shared_fraction": round(
+                    st["shared_tokens"] / max(total_prefill, 1), 3
                 ),
             }
         # the result line must survive teardown hiccups (stale request

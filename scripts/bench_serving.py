@@ -36,19 +36,27 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def serving_model_setup():
+def serving_model_setup(model: str = "qwen25_1p5b"):
     """The canonical serving-bench model: Qwen2.5-1.5B shapes, bf16,
     random weights.  Shared with bench.py's quick probe so the headline
-    serving numbers and SERVING_BENCH_r{N}.json can never desynchronise."""
+    serving numbers and SERVING_BENCH_r{N}.json can never desynchronise.
+    `model="tiny"` is the CPU smoke mode: wall-clock is meaningless there,
+    but the token-accounting signals (reused/shared fractions) are
+    workload arithmetic and carry over exactly."""
     import jax
 
     from areal_tpu.models import init_params
-    from areal_tpu.models.model_config import qwen25_1p5b
+    from areal_tpu.models.model_config import qwen25_1p5b, tiny_config
 
-    cfg = qwen25_1p5b().replace(
-        dtype="bfloat16", param_dtype="bfloat16", remat=False,
-        eos_token_id=None,
-    )
+    if model == "tiny":
+        cfg = tiny_config(vocab_size=512, qkv_bias=True,
+                          hf_architecture="Qwen2ForCausalLM",
+                          eos_token_id=None)
+    else:
+        cfg = qwen25_1p5b().replace(
+            dtype="bfloat16", param_dtype="bfloat16", remat=False,
+            eos_token_id=None,
+        )
     return cfg, init_params(cfg, jax.random.PRNGKey(0))
 
 
@@ -57,12 +65,14 @@ def _reset_stats(eng):
         eng.stats[k] = 0
 
 
-def _engine(cfg, params, n_slots, max_seq_len, kv_reuse=True, decode_chunk=8):
+def _engine(cfg, params, n_slots, max_seq_len, kv_reuse=True, decode_chunk=8,
+            **kw):
     from areal_tpu.gen.engine import GenEngine
 
     return GenEngine(
         cfg, params=params, n_slots=n_slots, max_seq_len=max_seq_len,
         prompt_bucket=128, decode_chunk=decode_chunk, kv_reuse=kv_reuse,
+        **kw,
     )
 
 
@@ -219,12 +229,78 @@ def bench_multi_turn(cfg, params, n_convs=8, turns=4, turn_prompt=64,
     return out
 
 
+def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
+                       gen_tokens=16, max_seq_len=1024):
+    """GRPO-shaped admission: `n_groups` groups of `group_size` requests
+    over ONE prompt each (distinct prompts across groups).  Share engine
+    (group fan-out prefill) vs no-share engine over the identical workload;
+    reports wall clock plus the hardware-independent signal —
+    `shared_prefill_fraction`: the fraction of grouped prompt tokens that
+    were NEVER recomputed (fanned out from the representative's KV)."""
+    from areal_tpu.gen.engine import GenRequest
+
+    out = {"group_size": group_size, "n_groups": n_groups,
+           "prompt_len": prompt_len}
+    for mode in ("share", "noshare"):
+        rng = np.random.default_rng(5)  # identical workload both modes
+        eng = _engine(cfg, params, group_size, max_seq_len,
+                      share_prefix=(mode == "share"))
+
+        def run_group(prompt, tag):
+            reqs = [
+                GenRequest(rid=f"{tag}-{i}", input_ids=list(prompt),
+                           max_new_tokens=gen_tokens, temperature=1.0,
+                           group_id=tag, group_n=group_size)
+                for i in range(group_size)
+            ]
+            eng.submit_batch(reqs)
+            while any(not r.stop_reason for r in reqs):
+                eng.step()
+
+        # warmup compiles every program the timed loop hits (prefill
+        # bucket, fan-out copy, sibling suffix bucket, decode)
+        run_group([1] * prompt_len, "warm")
+        _reset_stats(eng)
+        eng.retained_len[:] = 0  # no cross-group retained carryover
+        t0 = time.perf_counter()
+        for g in range(n_groups):
+            run_group(rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
+                      f"{mode}{g}")
+        dt = time.perf_counter() - t0
+        st = eng.stats
+        total = (st["prefill_tokens"] + st["suffix_tokens"]
+                 + st["reused_tokens"] + st["shared_tokens"])
+        out[mode] = {
+            "wall_s": round(dt, 2),
+            "prefill_tokens": st["prefill_tokens"],
+            "suffix_tokens": st["suffix_tokens"],
+            "shared_tokens": st["shared_tokens"],
+            "copy_calls": st["copy_calls"],
+            "shared_prefill_fraction": round(
+                st["shared_tokens"] / max(total, 1), 4
+            ),
+        }
+        print(f"group_fanout {mode}: {out[mode]}", file=sys.stderr,
+              flush=True)
+        del eng
+    out["shared_prefill_fraction"] = out["share"]["shared_prefill_fraction"]
+    out["speedup"] = round(
+        out["noshare"]["wall_s"] / max(out["share"]["wall_s"], 1e-9), 3
+    )
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--slots", default="8,32,64,128,256")
     p.add_argument("--skip-decode", action="store_true")
     p.add_argument("--skip-prefill", action="store_true")
     p.add_argument("--skip-multi-turn", action="store_true")
+    p.add_argument("--skip-group", action="store_true")
+    # group fan-out regime knobs (GRPO-shaped grouped admission)
+    p.add_argument("--group-size", type=int, default=8)
+    p.add_argument("--group-prompt", type=int, default=256)
+    p.add_argument("--n-groups", type=int, default=6)
     # multi-turn regime knobs — the published figures are reproduced with:
     #   decode-dominated floor: --turn-prompt 64  --turns 3 --mt-max-seq-len 1024
     #   prefill-dominated:      --turn-prompt 512 --turns 4 --mt-max-seq-len 4096
@@ -233,6 +309,9 @@ def main():
     p.add_argument("--turns", type=int, default=4)
     p.add_argument("--turn-gen", type=int, default=32)
     p.add_argument("--mt-max-seq-len", type=int, default=4096)
+    p.add_argument("--model", default="qwen25_1p5b",
+                   choices=["qwen25_1p5b", "tiny"],
+                   help="tiny = CPU smoke mode (token accounting only)")
     args = p.parse_args()
 
     import jax
@@ -242,8 +321,8 @@ def main():
         # re-apply the env choice so CPU smoke runs stay off the chip
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    cfg, params = serving_model_setup()
-    result = {"model": "qwen25_1p5b", "device_kind": jax.devices()[0].device_kind}
+    cfg, params = serving_model_setup(args.model)
+    result = {"model": args.model, "device_kind": jax.devices()[0].device_kind}
     if not args.skip_decode:
         result["decode"] = bench_decode(
             cfg, params, [int(s) for s in args.slots.split(",")]
@@ -254,6 +333,11 @@ def main():
         result["multi_turn"] = bench_multi_turn(
             cfg, params, turns=args.turns, turn_prompt=args.turn_prompt,
             turn_gen=args.turn_gen, max_seq_len=args.mt_max_seq_len,
+        )
+    if not args.skip_group and args.group_size > 1:
+        result["grouped"] = bench_group_fanout(
+            cfg, params, group_size=args.group_size,
+            n_groups=args.n_groups, prompt_len=args.group_prompt,
         )
     print(json.dumps(result))
 

@@ -437,3 +437,42 @@ def test_live_commit_keeps_inflight_request_decoding(live_server):
     assert resp["output_versions"][0] == v0
     assert resp["output_versions"][-1] == v1
     assert set(resp["output_versions"]) == {v0, v1}
+
+
+def test_generate_batch_groups_share_prefix(live_server):
+    """POST /generate_batch submits a whole GRPO group in one request: the
+    engine admits it as one prefix-sharing cluster (one representative
+    prefill + device-side KV fan-out), every member gets a full result,
+    and /metrics surfaces the shared-token accounting for the fleet."""
+    import json
+    import urllib.request
+
+    engine, addr = live_server
+    shared_before = engine.stats["shared_tokens"]
+    prompt = list(range(5, 25))  # > reuse_min_tokens so the cluster forms
+    body = {
+        "requests": [
+            {"rid": f"gb-{i}", "group_id": "gb", "group_n": 3,
+             "input_ids": prompt,
+             "sampling_params": {"max_new_tokens": 4, "temperature": 1.0}}
+            for i in range(3)
+        ]
+    }
+    req = urllib.request.Request(
+        f"http://{addr}/generate_batch",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert len(out["results"]) == 3
+    for r in out["results"]:
+        assert len(r["output_tokens"]) == 4
+        assert r["stop_reason"] == "length"
+    # the two siblings rode the representative's prefix KV
+    assert (engine.stats["shared_tokens"] - shared_before
+            >= 2 * (len(prompt) - 1))
+    m = json.loads(urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=5
+    ).read())
+    assert m["shared_tokens"] >= 2 * (len(prompt) - 1)
+    assert m["copy_calls"] >= 1
