@@ -1,0 +1,43 @@
+"""areal-lint: project-specific static analysis (ISSUE 3).
+
+Four AST checkers tuned to this codebase's invariants, plus an opt-in
+runtime validator for the lock annotations:
+
+- C1 `unlocked-field`   (lock_discipline)  — guarded fields under locks
+- C2 `host-sync` family (host_sync)        — hot-path device fences and
+  recompile hazards
+- C3 `async-blocking`   (async_blocking)   — event-loop stalls
+- C4 `dead-module`      (dead_modules)     — unreachable package code
+
+CLI: ``python scripts/lint.py --check`` (the tier-1 gate runs the same
+suite via tests/test_lint.py::test_repo_clean).  Catalog, annotation and
+suppression syntax: docs/lint.md.
+"""
+
+from areal_tpu.analysis.core import (
+    KNOWN_RULES,
+    Finding,
+    SourceFile,
+    load_files,
+    run_suite,
+    suppression_hygiene,
+    unsuppressed,
+)
+from areal_tpu.analysis.lockcheck import (
+    LockDisciplineError,
+    debug_locks_enabled,
+    lock_guarded,
+)
+
+__all__ = [
+    "KNOWN_RULES",
+    "Finding",
+    "SourceFile",
+    "load_files",
+    "run_suite",
+    "suppression_hygiene",
+    "unsuppressed",
+    "LockDisciplineError",
+    "debug_locks_enabled",
+    "lock_guarded",
+]

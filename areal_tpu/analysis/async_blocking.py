@@ -1,0 +1,115 @@
+"""C3 — blocking calls inside `async def` bodies.
+
+The generation server, router, and remote client all run on asyncio event
+loops that also carry health probes, weight-update control traffic, and
+the staleness gate; one ``time.sleep`` or synchronous HTTP call in a
+handler stalls every request on the loop.  This checker flags the known
+blocking families lexically inside any ``async def`` body:
+
+- ``time.sleep`` (use ``await asyncio.sleep``);
+- synchronous HTTP: ``requests.*``, ``urllib.request.urlopen``;
+- blocking file I/O: builtin ``open``/``io.open``, ``Path.read_text`` /
+  ``write_text`` / ``read_bytes`` / ``write_bytes``;
+- subprocess waits: ``subprocess.run/call/check_call/check_output``,
+  ``os.system``/``os.popen``.
+
+Nested synchronous ``def``s inside an async function are exempt — they
+are the standard vehicle for ``loop.run_in_executor`` offloads; the rule
+covers what the event loop itself executes.
+"""
+
+import ast
+from typing import List
+
+from areal_tpu.analysis.core import Finding, SourceFile, apply_suppression
+
+RULE = "async-blocking"
+
+_BLOCKING_EXACT = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "open": "blocking file I/O on the event loop; offload via "
+    "run_in_executor or read before entering async code",
+    "io.open": "blocking file I/O on the event loop",
+    "os.system": "blocks the loop until the child exits",
+    "os.popen": "blocks the loop until the child exits",
+    "subprocess.run": "blocks the loop until the child exits; use "
+    "asyncio.create_subprocess_exec",
+    "subprocess.call": "blocks the loop until the child exits",
+    "subprocess.check_call": "blocks the loop until the child exits",
+    "subprocess.check_output": "blocks the loop until the child exits",
+    "urllib.request.urlopen": "synchronous HTTP on the event loop; use "
+    "the aiohttp session",
+}
+_BLOCKING_PREFIXES = {
+    "requests.": "synchronous HTTP on the event loop; use the aiohttp "
+    "session",
+}
+_BLOCKING_METHODS = {
+    "read_text",
+    "write_text",
+    "read_bytes",
+    "write_bytes",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _walk_async_body(fn):
+    """Descendants of an async def, not descending into nested defs (sync
+    nested defs are executor fodder; nested async defs are scanned on
+    their own when the module walk reaches them)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def check_async_blocking(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if sf.tree is None:
+        return findings
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for node in _walk_async_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            why = _BLOCKING_EXACT.get(name)
+            if why is None:
+                for pref, pwhy in _BLOCKING_PREFIXES.items():
+                    if name.startswith(pref):
+                        why = pwhy
+                        break
+            if (
+                why is None
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+            ):
+                name = node.func.attr
+                why = "blocking file I/O on the event loop"
+            if why is not None:
+                findings.append(
+                    apply_suppression(
+                        sf,
+                        Finding(
+                            RULE,
+                            sf.rel,
+                            node.lineno,
+                            f"`{name}(...)` inside `async def {fn.name}` "
+                            f"blocks the event loop — {why}",
+                        ),
+                    )
+                )
+    return findings

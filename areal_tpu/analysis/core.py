@@ -1,0 +1,258 @@
+"""areal-lint core: findings, suppressions, and the source-file model.
+
+The project-specific static-analysis pass (ISSUE 3).  Every advisor round
+so far found the same failure classes by hand — guarded state mutated
+outside its lock, host syncs / recompile hazards on the hot serving path,
+event-loop stalls from blocking calls in `async def`, and modules shipped
+with zero importers.  This package encodes those invariants as four AST
+checkers (see the sibling modules) so they are enforced in tier-1 instead
+of living in reviewer memory:
+
+- C1 `unlocked-field`   — lock_discipline.py
+- C2 `host-sync` / `host-item` / `unbucketed-shape` — host_sync.py
+- C3 `async-blocking`   — async_blocking.py
+- C4 `dead-module`      — dead_modules.py
+
+Annotation surface (documented in docs/lint.md):
+
+- per-class ``_GUARDED_FIELDS = {"_field": "_lock", ...}`` registry, or a
+  ``# guarded-by: _lock`` comment on (or above) the field's ``__init__``
+  assignment;
+- ``# holds: _lock`` on a method that is only ever called with the lock
+  already held;
+- ``# areal-lint: hot-path`` marks a file for the C2 host-sync rules;
+- ``# areal-lint: disable=<rule>[,<rule>] <reason>`` suppresses findings
+  on that line (or the line below it); the reason is MANDATORY — a bare
+  disable is itself a finding (`bad-suppression`), so every intentional
+  exception stays visible and counted.
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+KNOWN_RULES = frozenset(
+    {
+        "unlocked-field",
+        "guard-syntax",
+        "host-sync",
+        "host-item",
+        "unbucketed-shape",
+        "async-blocking",
+        "dead-module",
+        "bad-suppression",
+    }
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*areal-lint:\s*disable=([A-Za-z0-9_,\-]+)\s*(.*?)\s*$"
+)
+_HOT_RE = re.compile(r"#\s*areal-lint:\s*hot-path\b")
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*([A-Za-z_]\w*)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def render(self) -> str:
+        tag = f" (suppressed: {self.suppress_reason})" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: List[str]
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed source file: AST + per-line comments + suppressions."""
+
+    def __init__(self, path: str, text: str, rel: Optional[str] = None):
+        self.path = path
+        self.rel = rel or path
+        self.text = text
+        self.error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.error = f"syntax error: {e}"
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenizeError, IndentationError, SyntaxError):
+            # comment extraction is best-effort; the AST parse above is
+            # what decides whether the file is analyzable at all
+            pass
+        self.suppressions: Dict[int, Suppression] = {}
+        for line, comment in self.comments.items():
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                rules = [r for r in m.group(1).split(",") if r]
+                self.suppressions[line] = Suppression(
+                    line=line, rules=rules, reason=m.group(2).strip()
+                )
+        self.hot = any(_HOT_RE.search(c) for c in self.comments.values())
+
+    @classmethod
+    def from_path(cls, path: str, rel: Optional[str] = None) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            return cls(path, f.read(), rel=rel)
+
+    # -- annotation helpers shared by the checkers ----------------------
+
+    def comment_near(self, line: int) -> str:
+        """Comment on `line`, falling back to the line above (annotations
+        may sit on their own line when the code line is long)."""
+        return self.comments.get(line) or self.comments.get(line - 1) or ""
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        m = _GUARDED_BY_RE.search(self.comment_near(line))
+        return m.group(1) if m else None
+
+    def holds_between(self, start: int, end: int) -> List[str]:
+        """All `# holds: <lock>` annotations on lines [start, end]."""
+        out = []
+        for ln in range(start, end + 1):
+            m = _HOLDS_RE.search(self.comments.get(ln, ""))
+            if m:
+                out.append(m.group(1))
+        return out
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        """Suppression covering `rule` at `line`: same line or the line
+        directly above the flagged one."""
+        for ln in (line, line - 1):
+            sup = self.suppressions.get(ln)
+            if sup is not None and rule in sup.rules:
+                return sup
+        return None
+
+    def file_suppression_for(self, rule: str) -> Optional[Suppression]:
+        """File-scope suppression (used by dead-module findings, which are
+        about the module as a whole): any disable of `rule` in the file."""
+        for sup in self.suppressions.values():
+            if rule in sup.rules:
+                return sup
+        return None
+
+
+def apply_suppression(sf: SourceFile, finding: Finding) -> Finding:
+    """Mark `finding` suppressed if an inline disable covers it."""
+    sup = sf.suppression_for(finding.rule, finding.line)
+    if sup is not None:
+        sup.used = True
+        finding.suppressed = True
+        finding.suppress_reason = sup.reason or "(no reason)"
+    return finding
+
+
+def suppression_hygiene(sf: SourceFile) -> List[Finding]:
+    """Every suppression must carry a reason and name known rules."""
+    out = []
+    for sup in sf.suppressions.values():
+        unknown = [r for r in sup.rules if r not in KNOWN_RULES]
+        if unknown:
+            out.append(
+                Finding(
+                    "bad-suppression",
+                    sf.rel,
+                    sup.line,
+                    f"disable names unknown rule(s) {unknown}; known rules: "
+                    f"{sorted(KNOWN_RULES)}",
+                )
+            )
+        if not sup.reason:
+            out.append(
+                Finding(
+                    "bad-suppression",
+                    sf.rel,
+                    sup.line,
+                    "suppression without a reason string — every intentional "
+                    "exception must say why (# areal-lint: disable=<rule> "
+                    "<reason>)",
+                )
+            )
+    return out
+
+
+DEFAULT_EXCLUDE = ("tests", "__pycache__", ".git")
+
+
+def iter_python_files(
+    root: str, exclude: Iterable[str] = DEFAULT_EXCLUDE
+) -> List[str]:
+    """Repo-relative paths of every scanned .py file: the package tree,
+    scripts/, examples/, and top-level modules — everything except tests
+    (fixtures under tests/data/lint would otherwise lint themselves)."""
+    exclude = set(exclude)
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        parts = [] if rel_dir == "." else rel_dir.split(os.sep)
+        if parts and (parts[0] in exclude or parts[0].startswith(".")):
+            dirnames[:] = []
+            continue
+        dirnames[:] = [
+            d for d in dirnames if d not in exclude and not d.startswith(".")
+        ]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                rel = os.path.normpath(os.path.join(rel_dir, fn))
+                out.append(rel if not rel.startswith("./") else rel[2:])
+    return sorted(out)
+
+
+def load_files(
+    root: str, exclude: Iterable[str] = DEFAULT_EXCLUDE
+) -> Dict[str, SourceFile]:
+    files: Dict[str, SourceFile] = {}
+    for rel in iter_python_files(root, exclude):
+        try:
+            files[rel] = SourceFile.from_path(
+                os.path.join(root, rel), rel=rel
+            )
+        except (OSError, UnicodeDecodeError):
+            continue
+    return files
+
+
+def run_suite(root: str, package: str = "areal_tpu") -> List[Finding]:
+    """Run all four checkers plus suppression hygiene over the tree."""
+    from areal_tpu.analysis.async_blocking import check_async_blocking
+    from areal_tpu.analysis.dead_modules import check_dead_modules
+    from areal_tpu.analysis.host_sync import check_host_sync
+    from areal_tpu.analysis.lock_discipline import check_lock_discipline
+
+    files = load_files(root)
+    findings: List[Finding] = []
+    for sf in files.values():
+        if sf.error is not None:
+            continue  # unparseable files are not lintable (none in-tree)
+        findings.extend(check_lock_discipline(sf))
+        findings.extend(check_host_sync(sf))
+        findings.extend(check_async_blocking(sf))
+        findings.extend(suppression_hygiene(sf))
+    findings.extend(check_dead_modules(root, files, package=package))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]

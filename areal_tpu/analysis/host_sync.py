@@ -1,0 +1,226 @@
+"""C2 — host-sync / recompile hazards on hot serving and training paths.
+
+The static complement of the jit-cache-counting tests: files marked
+``# areal-lint: hot-path`` (gen/engine.py, models/transformer.py,
+engine/jax_train.py, ops/*) are scanned for the patterns that silently
+serialise the device pipeline or mint new XLA programs mid-loop:
+
+- `host-item`: any ``.item()`` call — a synchronous device->host readback
+  per scalar, the classic decode-loop stall;
+- `host-sync`: ``np.asarray``/``np.array``/``float()``/``int()`` applied
+  to the result of a jitted callable (any callable named ``*_fn`` — the
+  repo convention for jitted programs — or a direct ``jax.jit(...)(...)``
+  call).  Each one is a device fence; intentional delivery points carry a
+  suppression so the fence count stays visible and counted;
+- `unbucketed-shape`: a ``len(...)``/``.shape``-derived int flowing into a
+  jitted call site without passing through ``round_up_to_bucket`` or a
+  power-of-two ``bit_length`` ladder — every distinct value compiles a new
+  program (the recompile-storm class the bucket ladders exist to prevent).
+
+The tracking is per-function and source-ordered: a name assigned from a
+jitted call is device-resident until reassigned from a host expression.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from areal_tpu.analysis.core import Finding, SourceFile, apply_suppression
+
+_BUCKETING_MARKERS = ("round_up_to_bucket", "bit_length")
+_HOST_CONVERTERS = {"float", "int"}
+_NP_CONVERTERS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
+                  ("numpy", "array")}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr.endswith("_fn"):
+        return True
+    if isinstance(f, ast.Name) and f.id.endswith("_fn"):
+        return True
+    # jax.jit(fn, ...)(args): callee is itself a jax.jit call
+    if isinstance(f, ast.Call) and _dotted(f.func) in ("jax.jit", "jit"):
+        return True
+    return False
+
+
+def _is_np_converter(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return (f.value.id, f.attr) in _NP_CONVERTERS
+    return False
+
+
+def _is_host_converter(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in _HOST_CONVERTERS:
+        return True
+    return _is_np_converter(call)
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(n) for n in ast.walk(node))
+
+
+def _is_shape_derived(expr: ast.AST) -> bool:
+    """len(...) or .shape in the expression, with no bucketing marker."""
+    def shapeish(n):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name):
+            if n.func.id == "len":
+                return True
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return True
+        return False
+
+    def bucketed(n):
+        if isinstance(n, ast.Call):
+            d = _dotted(n.func)
+            return any(d.endswith(m) for m in _BUCKETING_MARKERS)
+        return False
+
+    return _contains(expr, shapeish) and not _contains(expr, bucketed)
+
+
+def _assign_targets(node) -> List[str]:
+    targets = (
+        node.targets if isinstance(node, ast.Assign) else [node.target]
+    )
+    out: List[str] = []
+    for tgt in targets:
+        if isinstance(tgt, ast.Name):
+            out.append(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            out.extend(
+                el.id for el in tgt.elts if isinstance(el, ast.Name)
+            )
+    return out
+
+
+def _walk_shallow(fn):
+    """All descendants of `fn` WITHOUT descending into nested defs (nested
+    functions get their own scan with fresh state)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_function(sf: SourceFile, fn, findings: List[Finding]) -> None:
+    device: Set[str] = set()
+    shapeish: Set[str] = set()
+
+    # events in source order; an assignment's effect lands AFTER the calls
+    # inside its value expression are checked (so `x = np.asarray(x)` on a
+    # device-resident x is flagged at the conversion, then x becomes host)
+    events = []
+    for node in _walk_shallow(fn):
+        if isinstance(node, ast.Call):
+            events.append((node.lineno, node.col_offset, 0, "call", node))
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            end = getattr(node, "end_lineno", node.lineno)
+            events.append((end, node.col_offset, 1, "assign", node))
+    events.sort(key=lambda e: (e[0], e[2], e[1]))
+
+    for _, _, _, kind, node in events:
+        if kind == "call":
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "item":
+                findings.append(
+                    apply_suppression(
+                        sf,
+                        Finding(
+                            "host-item",
+                            sf.rel,
+                            node.lineno,
+                            ".item() is a per-scalar device->host sync; "
+                            "batch the readback (np.asarray once) or keep "
+                            "the value on device",
+                        ),
+                    )
+                )
+            if _is_host_converter(node) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in device:
+                    conv = _dotted(f)
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                "host-sync",
+                                sf.rel,
+                                node.lineno,
+                                f"{conv}({arg.id}) fences the device: "
+                                f"`{arg.id}` is the result of a jitted "
+                                "call — fetch once at a delivery point "
+                                "(and suppress with the reason) or keep "
+                                "it on device",
+                            ),
+                        )
+                    )
+            if _is_jit_call(node):
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    hazard = None
+                    if isinstance(arg, ast.Name) and arg.id in shapeish:
+                        hazard = arg.id
+                    elif isinstance(
+                        arg, (ast.Call, ast.BinOp, ast.Subscript, ast.Attribute)
+                    ) and _is_shape_derived(arg):
+                        hazard = ast.unparse(arg)[:40]
+                    if hazard is not None:
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    "unbucketed-shape",
+                                    sf.rel,
+                                    arg.lineno,
+                                    f"shape-derived value `{hazard}` flows "
+                                    "into a jitted call without bucketing "
+                                    "— every distinct value compiles a new "
+                                    "XLA program (use round_up_to_bucket / "
+                                    "a pow2 ladder)",
+                                ),
+                            )
+                        )
+        else:  # assign
+            targets = _assign_targets(node)
+            if not targets or node.value is None:
+                continue
+            val = node.value
+            if isinstance(val, ast.Call) and _is_jit_call(val):
+                device.update(targets)
+                shapeish.difference_update(targets)
+            elif isinstance(val, ast.Call) and _is_host_converter(val):
+                device.difference_update(targets)
+                shapeish.difference_update(targets)
+            elif _is_shape_derived(val):
+                shapeish.update(targets)
+                device.difference_update(targets)
+            else:
+                device.difference_update(targets)
+                shapeish.difference_update(targets)
+
+
+def check_host_sync(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if sf.tree is None or not sf.hot:
+        return findings
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(sf, node, findings)
+    return findings

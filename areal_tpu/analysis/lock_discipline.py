@@ -1,0 +1,254 @@
+"""C1 — lock discipline: guarded fields only touched under their lock.
+
+The static race detector for the concurrent state machines in
+`gen/engine.py`, `gen/server.py`, `gen/router.py`, `core/remote.py`, and
+`core/runner.py` (scanned repo-wide: it activates on any class that
+declares guarded fields).  The recurring failure class it encodes:
+ADVICE r5 found `_holdback` mutated outside `self._lock` by hand; this
+checker finds the next one mechanically.
+
+A field is declared lock-protected either way:
+
+    class Engine:
+        _GUARDED_FIELDS = {"_holdback": "_lock", "_abort_gen": "_lock"}
+
+or, next to the attribute's ``__init__`` assignment:
+
+    self._holdback = []  # guarded-by: _lock
+
+Every read/write of a guarded field (``self.<field>`` anywhere in the
+class) must then sit lexically inside ``with self.<lock>:`` /
+``async with self.<lock>:``, or in a method annotated ``# holds: <lock>``
+(a documented only-called-with-lock-held contract — the annotation is what
+the runtime assertion mode validates, see lockcheck.py).  ``__init__`` is
+exempt: no other thread can hold a reference yet.
+
+Accesses inside nested ``def``/``lambda`` bodies are NOT covered by an
+enclosing ``with`` — a closure may run after the lock is released — so
+they must carry their own ``# holds:`` annotation or take the lock.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from areal_tpu.analysis.core import Finding, SourceFile, apply_suppression
+
+RULE = "unlocked-field"
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _parse_registry(node: ast.AST) -> Optional[Dict[str, str]]:
+    """`_GUARDED_FIELDS = {...}` literal -> {field: lock}; None on a shape
+    the checker cannot statically evaluate."""
+    if isinstance(node, ast.Dict):
+        out: Dict[str, str] = {}
+        for k, v in zip(node.keys, node.values):
+            ks, vs = _literal_str(k), _literal_str(v)
+            if ks is None or vs is None:
+                return None
+            out[ks] = vs
+        return out
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        out = {}
+        for el in node.elts:
+            s = _literal_str(el)
+            if s is None:
+                return None
+            out[s] = "_lock"
+        return out
+    if isinstance(node, ast.Call):  # frozenset({...}) / dict(...)
+        if node.args and not node.keywords:
+            return _parse_registry(node.args[0])
+    return None
+
+
+def _guarded_fields(
+    sf: SourceFile, cls: ast.ClassDef, findings: List[Finding]
+) -> Dict[str, str]:
+    guarded: Dict[str, str] = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_GUARDED_FIELDS":
+                    reg = _parse_registry(stmt.value)
+                    if reg is None:
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    "guard-syntax",
+                                    sf.rel,
+                                    stmt.lineno,
+                                    "_GUARDED_FIELDS must be a literal dict "
+                                    "{field: lock} or a literal set/list of "
+                                    "field names",
+                                ),
+                            )
+                        )
+                    else:
+                        guarded.update(reg)
+    init = next(
+        (
+            s
+            for s in cls.body
+            if isinstance(s, ast.FunctionDef) and s.name == "__init__"
+        ),
+        None,
+    )
+    if init is not None:
+        for node in ast.walk(init):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        lock = sf.guarded_by(node.lineno)
+                        if lock:
+                            guarded[tgt.attr] = lock
+    return guarded
+
+
+def _holds_of(sf: SourceFile, fn: ast.AST) -> Set[str]:
+    """`# holds: <lock>` annotations attached to a def: on the def line,
+    the line above it, or any decorator line."""
+    start = fn.lineno
+    if getattr(fn, "decorator_list", None):
+        start = min(d.lineno for d in fn.decorator_list)
+    return set(sf.holds_between(start - 1, fn.body[0].lineno - 1))
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(
+        self,
+        sf: SourceFile,
+        cls_name: str,
+        guarded: Dict[str, str],
+        held: Set[str],
+        findings: List[Finding],
+    ):
+        self.sf = sf
+        self.cls_name = cls_name
+        self.guarded = guarded
+        self.held = set(held)
+        self.findings = findings
+
+    def _lock_names(self, with_node: ast.AST) -> List[str]:
+        out = []
+        for item in with_node.items:
+            e = item.context_expr
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+            ):
+                out.append(e.attr)
+        return out
+
+    def visit_With(self, node: ast.With):
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._visit_with(node)
+
+    def _visit_with(self, node):
+        added = [n for n in self._lock_names(node) if n not in self.held]
+        self.held.update(added)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.difference_update(added)
+
+    def _visit_nested(self, node):
+        # a nested def's body runs whenever the closure is invoked — the
+        # enclosing with-block guarantees nothing at that point
+        inner = _MethodChecker(
+            self.sf,
+            self.cls_name,
+            self.guarded,
+            _holds_of(self.sf, node),
+            self.findings,
+        )
+        for stmt in node.body:
+            inner.visit(stmt)
+        for d in getattr(node, "decorator_list", []):
+            self.visit(d)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        inner = _MethodChecker(
+            self.sf, self.cls_name, self.guarded, set(), self.findings
+        )
+        inner.visit(node.body)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.guarded
+        ):
+            lock = self.guarded[node.attr]
+            if lock not in self.held:
+                mode = (
+                    "written" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read"
+                )
+                self.findings.append(
+                    apply_suppression(
+                        self.sf,
+                        Finding(
+                            RULE,
+                            self.sf.rel,
+                            node.lineno,
+                            f"{self.cls_name}.{node.attr} {mode} without "
+                            f"holding self.{lock} (declare `with self."
+                            f"{lock}:` around it, or mark the method "
+                            f"`# holds: {lock}`)",
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+
+def check_lock_discipline(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    if sf.tree is None:
+        return findings
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = _guarded_fields(sf, cls, findings)
+        if not guarded:
+            continue
+        for meth in cls.body:
+            if not isinstance(
+                meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if meth.name == "__init__":
+                continue
+            checker = _MethodChecker(
+                sf, cls.name, guarded, _holds_of(sf, meth), findings
+            )
+            for stmt in meth.body:
+                checker.visit(stmt)
+    return findings

@@ -1,0 +1,173 @@
+"""Runtime validation of the C1 lock-discipline annotations.
+
+The static checker (lock_discipline.py) proves every *lexical* access to a
+guarded field sits under its lock; this module proves the annotation set
+matches *actual* lock usage by asserting ownership at runtime.  Opt-in via
+``AREAL_DEBUG_LOCKS=1`` (checked at instance construction): the existing
+gen-engine concurrency/abort-storm tests run with it enabled, so a field
+annotated as guarded that is in fact touched lock-free on some dynamic
+path raises `LockDisciplineError` instead of racing silently.
+
+Usage::
+
+    @lock_guarded
+    class GenEngine:
+        _GUARDED_FIELDS = {"_holdback": "_lock", "_abort_gen": "_lock"}
+
+With the env flag OFF (production, and every test that does not opt in)
+the decorator's only cost is one env lookup per construction — instances
+keep their original class and plain attribute access.
+
+With the flag ON, the instance is re-classed to a cached subclass where
+each guarded field is a data descriptor asserting the declared lock is
+held by the current thread on every read and write; `threading.Lock`
+attributes named by the registry are wrapped with an owner-tracking proxy
+(plain locks do not expose ownership).  `asyncio.Lock` degrades to a
+``locked()`` check — single-loop code cannot identify the holding task
+cheaply, so only the held-by-nobody violation is caught there.
+"""
+
+import os
+import threading
+from typing import Dict
+
+__all__ = [
+    "LockDisciplineError",
+    "debug_locks_enabled",
+    "lock_guarded",
+]
+
+
+class LockDisciplineError(AssertionError):
+    """A guarded field was touched without holding its declared lock."""
+
+
+def debug_locks_enabled() -> bool:
+    return os.environ.get("AREAL_DEBUG_LOCKS", "") == "1"
+
+
+class _OwnerTrackingLock:
+    """threading.Lock with owner identity, for held-by-me assertions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._owner = None
+
+    def acquire(self, *args, **kwargs):
+        got = self._lock.acquire(*args, **kwargs)
+        if got:
+            self._owner = threading.get_ident()
+        return got
+
+    def release(self):
+        self._owner = None
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+    def held_by_current_thread(self) -> bool:
+        return self._owner == threading.get_ident()
+
+
+def _normalize(registry) -> Dict[str, str]:
+    if isinstance(registry, dict):
+        return dict(registry)
+    return {name: "_lock" for name in registry}
+
+
+def _assert_held(instance, field: str, lock_name: str, mode: str) -> None:
+    lock = instance.__dict__.get(lock_name)
+    if lock is None:
+        lock = getattr(type(instance), lock_name, None)
+    if lock is None:
+        raise LockDisciplineError(
+            f"{type(instance).__name__}.{field}: declared lock "
+            f"`{lock_name}` does not exist on the instance"
+        )
+    probe = getattr(lock, "held_by_current_thread", None)
+    if probe is not None:
+        held = probe()
+    else:
+        # asyncio.Lock (or an unwrapped lock): best effort — catch the
+        # nobody-holds-it case, miss the someone-else-holds-it case
+        held = bool(getattr(lock, "locked", lambda: True)())
+    if not held:
+        raise LockDisciplineError(
+            f"{type(instance).__name__}.{field} {mode} without holding "
+            f"{lock_name} (AREAL_DEBUG_LOCKS=1)"
+        )
+
+
+def _guard_property(field: str, lock_name: str) -> property:
+    def fget(self):
+        _assert_held(self, field, lock_name, "read")
+        try:
+            return self.__dict__[field]
+        except KeyError:
+            raise AttributeError(field) from None
+
+    def fset(self, value):
+        _assert_held(self, field, lock_name, "write")
+        self.__dict__[field] = value
+
+    def fdel(self):
+        _assert_held(self, field, lock_name, "delete")
+        del self.__dict__[field]
+
+    return property(fget, fset, fdel)
+
+
+_CHECKED: Dict[type, type] = {}
+
+
+def _checked_class(cls: type) -> type:
+    checked = _CHECKED.get(cls)
+    if checked is None:
+        guarded = _normalize(cls._GUARDED_FIELDS)
+        ns = {
+            field: _guard_property(field, lock_name)
+            for field, lock_name in guarded.items()
+        }
+        checked = type(cls.__name__ + "+LockChecked", (cls,), ns)
+        _CHECKED[cls] = checked
+    return checked
+
+
+def lock_guarded(cls: type) -> type:
+    """Class decorator arming runtime guards for `_GUARDED_FIELDS` when
+    AREAL_DEBUG_LOCKS=1 (see module docstring)."""
+    if not hasattr(cls, "_GUARDED_FIELDS"):
+        raise TypeError(
+            f"@lock_guarded on {cls.__name__} requires a _GUARDED_FIELDS "
+            "registry"
+        )
+    orig_init = cls.__init__
+
+    def __init__(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        # exact-class check: a subclass runs this via super().__init__
+        # mid-construction, when re-classing would be premature (and its
+        # own guarded set may differ)
+        if type(self) is cls and debug_locks_enabled():
+            for lock_name in set(_normalize(cls._GUARDED_FIELDS).values()):
+                lock = self.__dict__.get(lock_name)
+                if isinstance(lock, type(threading.Lock())):
+                    wrapped = _OwnerTrackingLock()
+                    # the plain lock was just constructed in __init__ and
+                    # cannot be held yet; swap in place
+                    self.__dict__[lock_name] = wrapped
+            self.__class__ = _checked_class(cls)
+
+    __init__.__wrapped__ = orig_init
+    __init__.__doc__ = orig_init.__doc__
+    cls.__init__ = __init__
+    return cls

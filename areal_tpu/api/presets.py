@@ -240,3 +240,41 @@ def preset(name: str) -> Dict:
 
 def list_presets():
     return sorted(_PRESETS)
+
+
+def main():
+    """Preset browser / allocation helper:
+
+        python -m areal_tpu.api.presets                  # list names
+        python -m areal_tpu.api.presets gsm8k-grpo-1.5b  # config as JSON
+        python -m areal_tpu.api.presets --alloc 1.5e9 8  # just the
+                                                         # allocation expr
+
+    The JSON is the ready-to-edit config: dump to YAML and feed
+    load_expr_config, or use as overrides."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument(
+        "--alloc",
+        nargs=2,
+        metavar=("N_PARAMS", "N_CHIPS"),
+        help="print the auto allocation expression for a model size "
+        "(params, float ok: 1.5e9) on a chip budget",
+    )
+    p.add_argument("--ctx-len", type=int, default=4096)
+    args = p.parse_args()
+    if args.alloc:
+        n_params, n_devices = float(args.alloc[0]), int(args.alloc[1])
+        print(auto_allocation(n_devices, n_params, ctx_len=args.ctx_len))
+        return
+    if not args.name:
+        print("\n".join(list_presets()))
+        return
+    print(json.dumps(preset(args.name), indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,12 +9,16 @@ concatenated in row order.
 """
 
 import concurrent.futures
-from typing import Any, Dict, List, Sequence
+from typing import TYPE_CHECKING, Any, Dict, List, Sequence
 
 import numpy as np
 
 from areal_tpu.controller.batch import DistributedBatch
-from areal_tpu.scheduler.rpc_client import RPCEngineClient
+
+if TYPE_CHECKING:  # import-time would cycle: scheduler.rpc_client pulls
+    # controller.batch, whose package __init__ pulls this module — the
+    # name is only an annotation here
+    from areal_tpu.scheduler.rpc_client import RPCEngineClient
 
 
 def _merge_stats(
@@ -47,7 +51,7 @@ def _merge_stats(
 
 
 class TrainController:
-    def __init__(self, clients: List[RPCEngineClient], chunk_quantum: int = 1):
+    def __init__(self, clients: List["RPCEngineClient"], chunk_quantum: int = 1):
         """`chunk_quantum` aligns dp shard boundaries to a group size
         (GRPO group_size) so group-normalized ops never straddle shards."""
         if not clients:

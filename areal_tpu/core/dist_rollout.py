@@ -18,6 +18,7 @@ TPU-first differences from the reference:
 - No process groups to pick: the broadcast rides the same global runtime
   the train step uses.
 """
+# areal-lint: disable=dead-module multi-process subsystem consumed by the tests/mp worker harness and user multi-process train scripts; no in-tree daemon imports it yet (multi-host workstream)
 
 from typing import Any, Callable, Dict, List, Optional
 

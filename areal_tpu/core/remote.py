@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Protocol
 
 import aiohttp
 
+from areal_tpu.analysis.lockcheck import lock_guarded
 from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.engine import InferenceEngine
 from areal_tpu.api.io_struct import (
@@ -146,8 +147,19 @@ class RemoteInfBackendProtocol(Protocol):
     ) -> WeightUpdateRequests: ...
 
 
+@lock_guarded
 class RemoteInfEngine(InferenceEngine):
     """Client of N generation servers; owns the WorkflowExecutor."""
+
+    # scheduling/version state shared between the rollout event loop and
+    # the trainer's control-plane thread (areal-lint C1; runtime-validated
+    # under AREAL_DEBUG_LOCKS=1)
+    _GUARDED_FIELDS = {
+        "_version": "_lock",
+        "_server_idx": "_lock",
+        "_rid_to_addr": "_lock",
+        "_inflight": "_lock",
+    }
 
     def __init__(self, config: InferenceEngineConfig, backend: RemoteInfBackendProtocol):
         self.config = config
@@ -172,7 +184,10 @@ class RemoteInfEngine(InferenceEngine):
             self.addresses = self._discover_servers()
         if not self.addresses:
             raise RuntimeError("no generation servers found")
-        self._inflight = {a: 0 for a in self.addresses}
+        with self._lock:
+            # the executor's rollout loop may already be probing inflight
+            # counts; publishing the fresh table must be atomic with them
+            self._inflight = {a: 0 for a in self.addresses}
         logger.info(f"remote engine using servers: {self.addresses}")
         router_addr = self._discover_router()
         if router_addr:
@@ -228,7 +243,11 @@ class RemoteInfEngine(InferenceEngine):
     def choose_server(self) -> str:
         with self._lock:
             if self.config.schedule_policy == "least_requests":
-                return min(self.addresses, key=lambda a: self._inflight.get(a, 0))
+                # read the table under the lock, not from inside the
+                # lambda (a closure offers no static guarantee about when
+                # it runs relative to the lock)
+                inflight = self._inflight
+                return min(self.addresses, key=lambda a: inflight.get(a, 0))
             addr = self.addresses[self._server_idx % len(self.addresses)]
             self._server_idx += 1
             return addr

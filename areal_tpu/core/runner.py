@@ -6,6 +6,13 @@ loop; the main thread feeds async-task factories through a bounded queue and
 collects results from an output queue.  uvloop isn't in this image, so the
 stock loop is used (rollout workloads are HTTP-bound; the stock loop is
 sufficient and keeps the dependency surface zero).
+
+Lock-discipline audit (areal-lint C1): this class deliberately declares no
+`_GUARDED_FIELDS` — cross-thread handoff rides the two `queue.Queue`s and
+`threading.Event`s (self-synchronizing), `_n_running` is mutated only on
+the loop thread and read cross-thread as a monitoring hint, and
+`_exception` is write-once before the loop exits and read only by
+`health_check` afterwards.
 """
 
 import asyncio

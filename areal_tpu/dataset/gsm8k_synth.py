@@ -41,8 +41,8 @@ PROMPT_SUFFIX = (
 _TEMPLATE_WORDS = """
 User: Assistant: has buys more How many does have now gives away There are
 in each box boxes total shares equally among friends friend get and then
-left loses of so Buying Each holds there starts with The answer is Then
-gets Please reason step by put your final within
+left loses of so Buying Giving leaves Each holds there starts with The
+answer is Then gets Please reason step by put your final within
 """.split()
 
 _PUNCT = [".", ",", "?", "+", "-", "x", "/", "=", "\\boxed{", "}", "\n"]

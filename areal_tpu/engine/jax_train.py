@@ -25,6 +25,7 @@ micro-batches (fsdp_engine.py:499-606's global loss-weight normalisation).
 loss_fn must be a *stable* callable — the compiled step is cached per
 (id(loss_fn), shapes).
 """
+# areal-lint: hot-path
 
 import os
 import time

@@ -1,0 +1,1 @@
+# areal-lint: disable=dead-module experimental namespace for user-facing surfaces (reference parity: areal/experimental)

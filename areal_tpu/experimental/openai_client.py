@@ -14,6 +14,7 @@ self-contained: `client.chat.completions.create(...)` returns a response
 object with the fields agent code actually reads (.id, .choices[0].message
 .content, .usage).
 """
+# areal-lint: disable=dead-module user-facing OpenAI-compat facade imported by agent code outside the tree (reference parity: areal/experimental/openai); covered by tests/test_openai_client.py
 
 import asyncio
 import itertools

@@ -63,6 +63,7 @@ Design for XLA's static shapes:
   SGLang's RadixAttention / vLLM's shared PagedAttention blocks.
 """
 
+# areal-lint: hot-path
 import queue
 import threading
 import time
@@ -74,6 +75,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
+
+from areal_tpu.analysis.lockcheck import lock_guarded
 
 from areal_tpu.gen.sampling import sample_tokens
 from areal_tpu.models.model_config import TransformerConfig
@@ -136,7 +139,16 @@ class GenRequest:
             self.on_done(self)
 
 
+@lock_guarded
 class GenEngine:
+    # lock-discipline contract (areal-lint C1; runtime-validated under
+    # AREAL_DEBUG_LOCKS=1): the worker thread and control threads (abort,
+    # weight publish) hand requests across exactly these two fields, so
+    # every touch must hold _lock.  Slot arrays (slot_req, lengths,
+    # retained_len, ...) are worker-owned between the documented lock
+    # sections and stay outside the contract.
+    _GUARDED_FIELDS = {"_holdback": "_lock", "_abort_gen": "_lock"}
+
     def __init__(
         self,
         model_config: TransformerConfig,
@@ -308,6 +320,12 @@ class GenEngine:
             "shared_tokens": 0,  # cluster-prefix tokens fanned out, not recomputed
             "copy_calls": 0,  # device-side cross-slot prefix copies
             "decode_calls": 0,
+            # abort reservations whose TTL expired before the aborted
+            # owner resubmitted — makes the abort_reserve_s assumption
+            # observable (VERDICT r6 #10): a storm that reclaims in time
+            # keeps this at 0; a rising count means the TTL is too short
+            # (or clients stopped resubmitting)
+            "reservations_lapsed": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -985,6 +1003,13 @@ class GenEngine:
         # retained cache first; reserved slots stay parked for their
         # aborted owner's resubmission until the TTL lapses
         now = time.monotonic()
+        for s in free_set:
+            # owner never came back: the reservation lapses here (counted
+            # once — the slot re-enters the open pool below) rather than
+            # silently evaporating
+            if 0.0 < self._reserved_until[s] <= now:
+                self._reserved_until[s] = 0.0
+                self.stats["reservations_lapsed"] += 1
         open_slots = sorted(
             (s for s in free_set if self._reserved_until[s] <= now),
             key=lambda s: int(self.retained_len[s]),
@@ -1086,6 +1111,7 @@ class GenEngine:
             jnp.asarray(top_p),
             jnp.asarray(top_k),
         )
+        # areal-lint: disable=host-sync delivery point: one batched fetch per admission pass hands sampled tokens to the host scheduler
         toks, logps = np.asarray(toks), np.asarray(logps)
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += int(plens[: len(admitted)].sum())
@@ -1181,6 +1207,7 @@ class GenEngine:
             copy_block,
             key_window,
         )
+        # areal-lint: disable=host-sync delivery point: one batched fetch per suffix-admission pass (retained reuse + fan-out share it)
         toks, logps = np.asarray(toks), np.asarray(logps)
         self.stats["suffix_calls"] += 1
         if copy_block:
@@ -1335,6 +1362,7 @@ class GenEngine:
             jnp.asarray(top_p),
             jnp.asarray(top_k),
         )
+        # areal-lint: disable=host-sync delivery point: one batched fetch per VLM admission pass
         toks, logps = np.asarray(toks), np.asarray(logps)
         with self._lock:
             for i, (s, req) in enumerate(vlm_admitted):
@@ -1419,6 +1447,7 @@ class GenEngine:
             jnp.asarray(self.top_k),
             n,
         )
+        # areal-lint: disable=host-sync delivery point: ONE fused download per decode chunk is the designed host round-trip cadence
         out = np.asarray(out)  # [2, n, S]
         self.stats["decode_calls"] += 1
         toks = out[0].astype(np.int32)

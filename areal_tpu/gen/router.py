@@ -41,6 +41,7 @@ from typing import Dict, List, Optional
 import aiohttp
 from aiohttp import web
 
+from areal_tpu.analysis.lockcheck import lock_guarded
 from areal_tpu.utils import logging, name_resolve, names, network
 
 logger = logging.getLogger("gen.router")
@@ -69,7 +70,14 @@ class RouterConfig:
     alloc_ttl: float = 0.0
 
 
+@lock_guarded
 class Router:
+    # the fleet staleness gate's admission ledger: every handler that
+    # reads or mutates it does so under the asyncio _lock so capacity
+    # checks are atomic with lease grants (areal-lint C1; the asyncio
+    # flavor of the runtime check degrades to a locked() probe)
+    _GUARDED_FIELDS = {"_running": "_lock", "_accepted": "_lock"}
+
     def __init__(self, config: RouterConfig, addresses: Optional[List[str]] = None):
         self.config = config
         self.addresses: List[str] = list(addresses or [])
@@ -115,7 +123,7 @@ class Router:
 
     # ------------------------- staleness gate ---------------------------
 
-    def _prune_allocations(self) -> None:
+    def _prune_allocations(self) -> None:  # holds: _lock
         """Reclaim leases whose client never called /finish_request."""
         ttl = self.config.alloc_ttl or self.config.request_timeout
         cutoff = time.monotonic() - ttl
@@ -125,7 +133,7 @@ class Router:
         if stale:
             logger.warning(f"reclaimed {len(stale)} expired rollout allocations")
 
-    def _capacity(self) -> Optional[int]:
+    def _capacity(self) -> Optional[int]:  # holds: _lock
         """Remaining global admissions, or None when the gate is disabled.
 
         Same formula as StalenessManager.get_capacity (reference

@@ -19,6 +19,7 @@ attention, realhf/impl/model/modules/attn.py:307).  Design differences:
 - Sharding is expressed once in `param_partition_specs` and applied by the
   engine via NamedSharding; GSPMD inserts the collectives.
 """
+# areal-lint: hot-path
 
 import functools
 from typing import Any, Dict, NamedTuple, Optional

@@ -23,6 +23,7 @@ design differences:
 - The naive einsum path remains for CPU tests, odd head dims, and as the
   numerical reference; both paths share one public entry point.
 """
+# areal-lint: hot-path
 
 import functools
 from typing import Optional

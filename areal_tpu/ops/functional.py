@@ -11,6 +11,7 @@ single jit over the mesh a `jnp.sum` is already a global sum, no psum needed.
 Softmax/log-softmax run in fp32 regardless of activation dtype (MXU-friendly
 bf16 matmuls, fp32 numerics).
 """
+# areal-lint: hot-path
 
 from typing import Dict, Optional, Tuple
 

@@ -26,6 +26,7 @@ with an online softmax** instead of sharding vocab across ranks:
   when the caller actually trains on it (`entropy_grad`); the argmax
   "correct" output is always gradient-free.
 """
+# areal-lint: hot-path
 
 from functools import partial
 from typing import Tuple

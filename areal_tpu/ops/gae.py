@@ -12,6 +12,7 @@ across the whole (packed) buffer at once; sequence boundaries reset the
 carry, which is exactly the cu_seqlens-misalignment handling of the CUDA
 kernel, but shape-static and fusable by XLA.
 """
+# areal-lint: hot-path
 
 from typing import Optional, Tuple
 

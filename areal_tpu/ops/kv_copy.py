@@ -28,6 +28,7 @@ prefill writes `[prefix_len, prefix_len + P)` before its queries run, and
 decode writes position `lengths` each step before attending `<= lengths`
 (the same frontier invariant padded suffix rows already rely on).
 """
+# areal-lint: hot-path
 
 from typing import Dict
 

@@ -1,3 +1,4 @@
+# areal-lint: disable=dead-module recipe library surface consumed by user training scripts (reference parity: AReaL recipe/); covered by tests/test_aent.py
 from areal_tpu.recipes.aent import AEntConfig, AEntPPOActorConfig, JaxAEntPPOActor
 
 __all__ = ["AEntConfig", "AEntPPOActorConfig", "JaxAEntPPOActor"]

@@ -18,6 +18,7 @@ batch (a per-row array) instead of a Python closure — rebuilding the
 closure each step would recompile the fused train step on every
 coefficient change.
 """
+# areal-lint: disable=dead-module AEnt recipe consumed by user training scripts via areal_tpu.recipes (reference parity: AReaL recipe/AEnt); covered by tests/test_aent.py
 
 import functools
 from dataclasses import dataclass, field

@@ -152,3 +152,77 @@ def serve_engine(
         )
     logger.info(f"engine rpc server on :{port} ({type(worker).__name__})")
     web.run_app(server.app(), port=port, print=None)
+
+
+def main():
+    """Worker-daemon entry point for the single-controller deployment:
+
+        python -m areal_tpu.scheduler.rpc_server --model-path ... --port N
+
+    spawns one engine worker process (the controller drives it over POST
+    /call); a blank --model-path serves a tiny from-scratch actor, the CPU
+    smoke shape (examples/rpc_controller/grpo_rpc_controller.py)."""
+    import argparse
+
+    from areal_tpu.api.config import (
+        MeshConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.ppo import JaxPPOActor
+    from areal_tpu.models.model_config import TransformerConfig, tiny_config
+
+    name_resolve.reconfigure_from_env()
+    p = argparse.ArgumentParser()
+    p.add_argument("--model-path", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--group-size", type=int, default=2)
+    p.add_argument("--pack-length-quantum", type=int, default=256)
+    p.add_argument("--lr", type=float, default=1e-6)
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--worker-idx", type=int, default=0)
+    args = p.parse_args()
+    if args.model_path:
+        model_cfg = TransformerConfig.from_hf(args.model_path)
+        dtype = "bfloat16"
+    else:
+        model_cfg = tiny_config(
+            vocab_size=512, qkv_bias=True, hf_architecture="Qwen2ForCausalLM"
+        )
+        dtype = "float32"
+    cfg = PPOActorConfig(
+        experiment_name=args.experiment_name or "rpc-worker",
+        trial_name=args.trial_name or "t",
+        init_from_scratch=not args.model_path,
+        path=args.model_path,
+        dtype=dtype,
+        param_dtype=dtype,
+        mesh=MeshConfig(),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+        optimizer=OptimizerConfig(lr=args.lr, warmup_steps_proportion=0.0),
+        pack_length_quantum=args.pack_length_quantum,
+        group_size=args.group_size,
+        ppo_n_minibatches=1,
+        adv_norm=NormConfig(
+            mean_level="group",
+            std_level="group",
+            group_size=args.group_size,
+        ),
+    )
+    actor = JaxPPOActor(cfg, model_config=model_cfg)
+    actor.initialize(ft_spec=FinetuneSpec(1, 4096, 8))
+    serve_engine(
+        actor,
+        port=args.port or None,
+        experiment_name=args.experiment_name,
+        trial_name=args.trial_name,
+        worker_idx=args.worker_idx,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -266,9 +266,13 @@ def main():
 
     # primary-metric carry-over: the full async-vs-sync e2e loop takes
     # ~20 min on chip (scripts/bench_e2e_grpo.py), so its latest recorded
-    # run rides along here instead of re-running inside the bench budget
+    # run rides along here instead of re-running inside the bench budget.
+    # Every carried field is marked in result["stale_from"] with the round
+    # it was actually measured in (VERDICT r6 #6): these numbers are NOT
+    # re-measured by this bench run and must not read as current.
     try:
         import glob
+        import re as _re
 
         runs = sorted(glob.glob(os.path.join(
             os.path.dirname(os.path.abspath(__file__)),
@@ -276,6 +280,13 @@ def main():
         if runs:
             with open(runs[-1]) as f:
                 e2e = json.load(f)
+            m = _re.search(r"_r(\d+)\.json$", runs[-1])
+            stale_round = f"r{m.group(1)}" if m else os.path.basename(runs[-1])
+            carried = result.setdefault("stale_from", {})
+
+            def _carry(key, value):
+                result[key] = value
+                carried[key] = stale_round
             # prefer the run BASELINE.json.published quotes: the
             # heterogeneous-length workload (its latest rerun), falling
             # back to the uniform-length live-swap run
@@ -287,20 +298,20 @@ def main():
                 or e2e
             )
             result["e2e_artifact"] = os.path.basename(runs[-1])
-            result["e2e_async_trajs_per_sec_per_chip"] = (
-                live["async"]["trajs_per_sec_per_chip"])
-            result["e2e_async_over_sync"] = (
-                live["async_over_sync_trajs_per_sec"])
+            _carry("e2e_async_trajs_per_sec_per_chip",
+                   live["async"]["trajs_per_sec_per_chip"])
+            _carry("e2e_async_over_sync",
+                   live["async_over_sync_trajs_per_sec"])
             pause = live["async"].get("pause_window_s_mean")
             if pause is None:  # 0.0 is a real (sub-ms) measurement
                 pause = het.get("async", {}).get("pause_window_s_mean")
-            result["e2e_publish_pause_s"] = pause
+            _carry("e2e_publish_pause_s", pause)
             mt = e2e.get("multi_turn_agentic")
             if mt:
-                result["e2e_multiturn_async_over_sync"] = (
-                    mt["async_over_sync_trajs_per_sec"])
-                result["e2e_multiturn_kv_reused_fraction"] = (
-                    mt["kv_reuse"]["reused_fraction"])
+                _carry("e2e_multiturn_async_over_sync",
+                       mt["async_over_sync_trajs_per_sec"])
+                _carry("e2e_multiturn_kv_reused_fraction",
+                       mt["kv_reuse"]["reused_fraction"])
     except Exception as e:  # noqa: BLE001 — informational extras
         print(f"bench: e2e carry-over failed: {str(e)[:120]}",
               file=sys.stderr)

@@ -1,0 +1,129 @@
+"""Single-controller GRPO over RPC engine workers (CPU-runnable demo).
+
+The deployment mode `areal_tpu.controller` + `areal_tpu.scheduler`
+implement (reference: areal/scheduler/rpc/ + areal/controller/ single-
+controller mode): algorithm code runs in ONE process; each engine worker
+is a separate process owning its own jax mesh, driven over HTTP RPC.
+Batches are chunked row-wise across the fleet by `TrainController` and
+results merge back — the controller never touches a device.
+
+This script is the smallest honest end-to-end slice: it spawns N worker
+daemons via the real entry point
+
+    python -m areal_tpu.scheduler.rpc_server --port <p>
+
+waits for /health, then runs a few synthetic GRPO steps through
+`TrainController` (logp -> advantages -> ppo_update) and prints the final
+loss.  Swap `--model-path` onto the worker command line and raise the
+sizes for a real run; the controller side does not change.
+
+    python examples/rpc_controller/grpo_rpc_controller.py --workers 2
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+
+from areal_tpu.controller import TrainController  # noqa: E402
+from areal_tpu.scheduler import RPCEngineClient  # noqa: E402
+from areal_tpu.utils import network  # noqa: E402
+
+VOCAB = 512  # matches the worker daemon's tiny fallback model
+
+
+def _spawn_worker(port: int) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.scheduler.rpc_server",
+            "--port",
+            str(port),
+            "--pack-length-quantum",
+            "16",
+        ],
+        cwd=_REPO,
+        env=env,
+    )
+
+
+def _wait_healthy(addr: str, timeout: float = 180.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(f"http://{addr}/health", timeout=2)
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise TimeoutError(f"worker at {addr} never became healthy")
+
+
+def _synthetic_batch(rng, batch_size: int, seq_len: int, prompt_len: int):
+    """GRPO-shaped rows: packed ids, loss on the completion span, binary
+    rewards, behavior logprobs (a real run feeds rollout output here)."""
+    ids = rng.integers(0, VOCAB, (batch_size, seq_len)).astype(np.int32)
+    loss_mask = np.zeros((batch_size, seq_len), np.float32)
+    loss_mask[:, prompt_len:] = 1.0
+    return {
+        "input_ids": ids,
+        "attention_mask": np.ones((batch_size, seq_len), bool),
+        "loss_mask": loss_mask,
+        "logprobs": rng.normal(-1.0, 0.1, (batch_size, seq_len)).astype(
+            np.float32
+        ),
+        "rewards": rng.integers(0, 2, batch_size).astype(np.float32),
+        "versions": np.zeros((batch_size, seq_len), np.int32),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--steps", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=16)
+    args = p.parse_args(argv)
+
+    ports = [network.find_free_port() for _ in range(args.workers)]
+    procs = [_spawn_worker(port) for port in ports]
+    addrs = [f"127.0.0.1:{port}" for port in ports]
+    try:
+        for addr in addrs:
+            _wait_healthy(addr)
+        ctl = TrainController(
+            [RPCEngineClient(a) for a in addrs], chunk_quantum=2
+        )
+        rng = np.random.default_rng(0)
+        for step in range(args.steps):
+            batch = _synthetic_batch(rng, args.batch_size, args.seq_len, 4)
+            batch["prox_logp"] = ctl.compute_logp(batch)
+            ctl.compute_advantages(batch)
+            stats = ctl.ppo_update(batch)
+            ctl.set_version(step + 1)
+            print(
+                f"step {step}: loss={stats[-1]['loss']:.4f} over "
+                f"{args.workers} workers",
+                flush=True,
+            )
+        print("ok")
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    main()

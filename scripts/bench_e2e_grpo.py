@@ -212,6 +212,7 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
     measure different things."""
     trajs = tokens = 0
     pauses = []
+    rewards = []
     t_start = None
     for step in range(warmup + steps):
         if step == warmup:
@@ -220,10 +221,12 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
             jax.block_until_ready(actor.params)
             trajs = tokens = 0
             pauses = []
+            rewards = []
             t_start = time.perf_counter()
         batch = get_batch()
         trajs += int(np.asarray(batch["attention_mask"]).shape[0])
         tokens += _batch_tokens(batch)
+        rewards.append(float(np.asarray(batch["rewards"]).mean()))
         _train_consume(actor, batch)
         pauses.append(publish())
         print(f"{label}{mode} step {step}: trajs={trajs} tokens={tokens}",
@@ -241,6 +244,9 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
         "trajs_per_sec_per_chip": round(trajs / wall, 3),
         "effective_tokens_per_sec_per_chip": round(tokens / wall, 1),
         "pause_window_s_mean": round(float(np.mean(pauses)), 3),
+        # the quality half's raw signal (meaningful for --dataset
+        # gsm8k-synth, where the reward is the real math grader)
+        "reward_mean": round(float(np.mean(rewards)), 4),
     }
 
 
@@ -411,10 +417,10 @@ def main():
                         "budget in [max_new/(1+j), max_new] — length "
                         "variance a la real math workloads")
     p.add_argument("--publish-mode", default="live",
-                   choices=["live", "interrupt"],
+                   choices=["live", "interrupt", "abort"],
                    help="live = non-aborting swap_weights_live (the "
-                        "default everywhere since r5); interrupt = "
-                        "abort-and-resume for A/B comparison")
+                        "default everywhere since r5); interrupt/abort "
+                        "(synonyms) = abort-and-resume for A/B comparison")
     p.add_argument("--share-prefix", default="on", choices=["on", "off"],
                    help="off = pre-fan-out admission (per-slot retained "
                         "reuse only) for A/B regression runs")
@@ -423,7 +429,18 @@ def main():
                    help="colocated = in-process ColocatedEngine handoff; "
                         "remote = REAL GenServer over HTTP + RemoteJaxEngine "
                         "+ transfer-mode weight publish (the fleet slice)")
+    p.add_argument("--dataset", default="random",
+                   choices=["random", "gsm8k-synth"],
+                   help="random = synthetic token prompts (throughput "
+                        "measurement); gsm8k-synth = the synthetic GSM8K "
+                        "generator + WordTokenizer + the REAL "
+                        "gsm8k_reward_fn (dataset/gsm8k_synth.py) — the "
+                        "quality-half workload, learnable rewards included")
     args = p.parse_args()
+    interrupt_publish = args.publish_mode in ("interrupt", "abort")
+    if args.dataset == "gsm8k-synth" and args.workflow != "rlvr":
+        p.error("--dataset gsm8k-synth runs the RLVR workflow (its reward "
+                "parses \\boxed{} answers, not multi-turn feedback)")
     if args.workflow == "multi_turn" and args.len_jitter > 0:
         # MultiTurnWorkflow generates with its fixed gconfig budget; per-item
         # budgets would be ignored and the result JSON would claim a
@@ -467,7 +484,7 @@ def main():
         client.initialize(addr=addr)
         meta = WeightUpdateMeta.from_transfer(
             "e2e-bench", "b", chunk_mb=64,
-            live_commit=args.publish_mode == "live",
+            live_commit=not interrupt_publish,
         )
     prewarm_reward_pool()
     if args.workflow == "multi_turn":
@@ -483,6 +500,29 @@ def main():
             tokenizer=_FakeTokenizer(),
             max_turns=args.max_turns,
         )
+    elif args.dataset == "gsm8k-synth":
+        # the quality-half workload (dataset/gsm8k_synth.py): real word
+        # problems through the closed-vocabulary tokenizer, scored by the
+        # REAL math reward — rewards are learnable, not coin flips
+        from areal_tpu.dataset.gsm8k_synth import (
+            WordTokenizer,
+            generate_problems,
+        )
+        from areal_tpu.reward.math_parser import gsm8k_reward_fn
+
+        synth_tok = WordTokenizer()
+        assert len(synth_tok) <= cfg.vocab_size, (
+            f"model vocab {cfg.vocab_size} < tokenizer {len(synth_tok)}"
+        )
+        workflow = RLVRWorkflow(
+            reward_fn=gsm8k_reward_fn,
+            gconfig=GenerationHyperparameters(
+                n_samples=args.group_size,
+                max_new_tokens=args.max_new_tokens,
+                temperature=1.0,
+            ),
+            tokenizer=synth_tok,
+        )
     else:
         workflow = RLVRWorkflow(
             reward_fn=_reward_any_even,
@@ -494,23 +534,36 @@ def main():
         )
     rng = np.random.default_rng(0)
     dataset = []
-    for i in range(256):
-        item = {
-            "input_ids": rng.integers(0, cfg.vocab_size,
-                                      args.prompt_len).tolist(),
-            "query_id": str(i),
-        }
-        if args.len_jitter > 0:
-            # realistic length variance (the reference's math workloads
-            # span 1k-31k generated tokens): log-uniform budgets in
-            # [max_new/(1+j), max_new].  Sync pays the straggler tail every
-            # step; async absorbs it — this is the regime the async design
-            # targets.
-            lo = args.max_new_tokens / (1.0 + args.len_jitter)
-            item["max_new_tokens"] = int(np.exp(
-                rng.uniform(np.log(lo), np.log(args.max_new_tokens))
-            ))
-        dataset.append(item)
+    if args.dataset == "gsm8k-synth":
+        for prob in generate_problems(256, seed=0):
+            dataset.append({
+                "input_ids": synth_tok.apply_chat_template(
+                    prob["messages"], add_generation_prompt=True
+                ),
+                "query_id": prob["query_id"],
+                "answer": prob["answer"],
+            })
+        # warm-shape planning sizes rows from args.prompt_len; cover the
+        # longest generated problem so the packer's signatures match
+        args.prompt_len = max(len(d["input_ids"]) for d in dataset)
+    else:
+        for i in range(256):
+            item = {
+                "input_ids": rng.integers(0, cfg.vocab_size,
+                                          args.prompt_len).tolist(),
+                "query_id": str(i),
+            }
+            if args.len_jitter > 0:
+                # realistic length variance (the reference's math workloads
+                # span 1k-31k generated tokens): log-uniform budgets in
+                # [max_new/(1+j), max_new].  Sync pays the straggler tail
+                # every step; async absorbs it — this is the regime the
+                # async design targets.
+                lo = args.max_new_tokens / (1.0 + args.len_jitter)
+                item["max_new_tokens"] = int(np.exp(
+                    rng.uniform(np.log(lo), np.log(args.max_new_tokens))
+                ))
+            dataset.append(item)
     shapes = plan_warm_shapes(args, dataset, actor)
     print(f"warming {len(shapes)} pack signatures: {shapes}",
           file=sys.stderr, flush=True)
@@ -523,6 +576,7 @@ def main():
         "model": args.model,
         "workflow": args.workflow,
         "transport": args.transport,
+        "dataset": args.dataset,
         "device_kind": jax.devices()[0].device_kind,
         "batch_size": args.batch_size,
         "group_size": args.group_size,
@@ -545,7 +599,7 @@ def main():
                 result[mode] = run_mode(
                     mode, actor, serving, workflow, dataset,
                     args.batch_size, args.steps, warmup=args.warmup,
-                    interrupt_publish=args.publish_mode == "interrupt",
+                    interrupt_publish=interrupt_publish,
                 )
         if "sync" in result and "async" in result:
             result["async_over_sync_trajs_per_sec"] = round(

@@ -1,0 +1,72 @@
+"""areal-lint CLI: run the project static-analysis suite (ISSUE 3).
+
+    python scripts/lint.py              # report all findings
+    python scripts/lint.py --check     # exit 1 on unsuppressed findings
+                                        # (the tier-1 gate semantics)
+    python scripts/lint.py --suppressed # also list suppressed findings
+
+Checker catalog, annotation syntax (`_GUARDED_FIELDS`, `# guarded-by:`,
+`# holds:`, `# areal-lint: hot-path`) and the suppression format
+(`# areal-lint: disable=<rule> <reason>`): docs/lint.md.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.analysis import run_suite, unsuppressed  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="project root to scan (default: this repo)",
+    )
+    p.add_argument(
+        "--check",
+        action="store_true",
+        help="exit nonzero when any unsuppressed finding exists",
+    )
+    p.add_argument(
+        "--suppressed",
+        action="store_true",
+        help="also print suppressed findings (they are always counted)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    args = p.parse_args(argv)
+
+    findings = run_suite(args.root)
+    active = unsuppressed(findings)
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [vars(f) for f in active],
+                    "suppressed": [vars(f) for f in suppressed],
+                }
+            )
+        )
+    else:
+        for f in active:
+            print(f.render())
+        if args.suppressed:
+            for f in suppressed:
+                print(f.render())
+        print(
+            f"areal-lint: {len(active)} finding(s), "
+            f"{len(suppressed)} suppressed"
+        )
+    if args.check and active:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

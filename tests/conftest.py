@@ -28,6 +28,10 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
+# areal-lint fixture trees under data/ contain test-shaped files (e.g. the
+# C4 dead-module tree's tests/ dir) that are lint *inputs*, not tests
+collect_ignore_glob = ["data/*"]
+
 
 @pytest.fixture(autouse=True)
 def _seed():

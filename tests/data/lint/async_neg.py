@@ -1,0 +1,21 @@
+"""C3 negative fixture: the loop-safe versions.  Zero findings expected."""
+
+import asyncio
+import time
+
+
+async def handler(request, session, loop):
+    await asyncio.sleep(0.1)  # cooperative wait
+
+    def blocking_read():  # executor fodder: sync nested def is exempt
+        with open("/tmp/state.json") as f:
+            return f.read()
+
+    data = await loop.run_in_executor(None, blocking_read)
+    async with session.get("http://backend/health") as resp:
+        body = await resp.json()
+    return body, data
+
+
+def sync_helper():
+    time.sleep(0.1)  # blocking is fine off the loop

@@ -1,0 +1,21 @@
+"""C3 positive fixture: blocking calls on the event loop.
+
+Expected findings: one async-blocking per marked line.
+"""
+
+import time
+
+import requests
+
+
+async def handler(request):
+    time.sleep(0.1)  # VIOLATION: stalls every request on the loop
+    body = requests.get("http://backend/health")  # VIOLATION: sync HTTP
+    with open("/tmp/state.json") as f:  # VIOLATION: blocking file I/O
+        data = f.read()
+    return body, data
+
+
+class Service:
+    async def flush(self):
+        self.path.write_text("done")  # VIOLATION: blocking file I/O

@@ -1,0 +1,5 @@
+"""Non-test root: whatever this imports (transitively) is alive."""
+
+from myproj.used import run
+
+print(run())

@@ -1,0 +1,1 @@
+"""Fixture package for the C4 dead-module checker."""

@@ -1,0 +1,9 @@
+"""Alive: an executable entry point (python -m myproj.cli)."""
+
+
+def main():
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

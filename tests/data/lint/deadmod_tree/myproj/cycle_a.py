@@ -1,0 +1,4 @@
+"""DEAD despite the internal cycle: cycle_a <-> cycle_b import each
+other but nothing outside the pair reaches them."""
+
+import myproj.cycle_b  # noqa: F401

@@ -1,0 +1,3 @@
+"""DEAD: see cycle_a."""
+
+import myproj.cycle_a  # noqa: F401

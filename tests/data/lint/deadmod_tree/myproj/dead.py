@@ -1,0 +1,6 @@
+"""DEAD: only tests/test_app.py imports this — test importers never
+count, so the checker must flag it."""
+
+
+def unreachable():
+    return 42

@@ -1,0 +1,5 @@
+"""Alive transitively: imported by used.py, which a root imports."""
+
+
+def add(a, b):
+    return a + b

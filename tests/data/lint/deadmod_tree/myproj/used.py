@@ -1,0 +1,7 @@
+"""Alive: imported by app.py (a non-test root)."""
+
+from myproj.helper import add
+
+
+def run():
+    return add(1, 2)

@@ -1,0 +1,6 @@
+"""Suppressed: an intentional library surface with a stated reason."""
+# areal-lint: disable=dead-module experimental user-facing surface kept for downstream scripts
+
+
+def api():
+    return "stable"

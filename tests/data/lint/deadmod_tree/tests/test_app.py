@@ -1,0 +1,7 @@
+"""Test importer — must NOT keep myproj.dead alive."""
+
+from myproj.dead import unreachable
+
+
+def test_unreachable():
+    assert unreachable() == 42

@@ -1,0 +1,29 @@
+"""C2 negative fixture (marked hot): the disciplined versions.
+
+Zero findings expected: bucketed shape ints, host-only numpy work, and a
+reassignment that makes a former device result host-resident before any
+conversion.
+"""
+# areal-lint: hot-path
+
+import numpy as np
+
+from areal_tpu.utils.datapack import round_up_to_bucket
+
+
+def disciplined(self, prompts):
+    bucket = round_up_to_bucket(
+        max(len(r) for r in prompts), self.prompt_bucket, self.max_seq_len
+    )
+    rows = 1 << (len(prompts) - 1).bit_length()  # pow2 ladder: bucketed
+    ids = np.zeros((rows, bucket), np.int32)  # host-side staging is free
+    toks, cache = self._prefill_fn(self.params, ids, bucket)
+    self.cache = cache  # stays on device
+    plens = np.ones(rows, np.int32)
+    total = int(plens.sum())  # int() on host numpy: no fence
+    return toks, total
+
+
+def host_only(batch):
+    mask = np.asarray(batch["mask"])  # wire data, never device-resident
+    return float(mask.mean())

@@ -1,0 +1,26 @@
+"""C2 positive fixture (marked hot): host syncs + recompile hazards.
+
+Expected findings: host-sync (np.asarray/float on a jitted result),
+host-item (.item()), unbucketed-shape (len()-derived int into a jitted
+call).
+"""
+# areal-lint: hot-path
+
+import jax
+import numpy as np
+
+
+def decode_loop(self, prompts):
+    toks, cache = self._decode_fn(self.params, self.cache)
+    host = np.asarray(toks)  # VIOLATION host-sync: fence per loop pass
+    first = float(toks)  # VIOLATION host-sync: scalar fence
+    flag = cache.sum().item()  # VIOLATION host-item
+    n = len(prompts)  # un-bucketed shape int
+    out = self._prefill_fn(self.params, n)  # VIOLATION unbucketed-shape
+    out2 = self._prefill_fn(self.params, len(prompts))  # VIOLATION inline
+    return host, first, flag, out, out2
+
+
+def direct_jit(params, xs):
+    y = jax.jit(lambda p: p)(params)
+    return int(y)  # VIOLATION host-sync on a jax.jit(...)(...) result

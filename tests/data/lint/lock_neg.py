@@ -1,0 +1,54 @@
+"""C1 negative fixture: every guarded access holds its lock.
+
+Zero findings expected.  The mutation test also consumes this file: it
+rewrites `with self._lock:` to `if True:` and asserts the checker then
+fires — the acceptance case "deleting a with-lock guard is caught".
+"""
+
+import asyncio
+import threading
+
+
+class Disciplined:
+    _GUARDED_FIELDS = {"_queue": "_lock", "_counter": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._counter = 0
+        self._free = 0  # unguarded: not part of the contract
+
+    def good_write(self):
+        with self._lock:
+            self._queue.append(1)
+            self._counter += 1
+
+    def good_swap(self):
+        with self._lock:
+            intake = self._queue
+            self._queue = []
+        return intake  # the alias is owned by this thread now
+
+    def _drain(self):  # holds: _lock
+        out = list(self._queue)
+        self._queue = []
+        return out
+
+    def good_caller(self):
+        with self._lock:
+            return self._drain()
+
+    def untracked(self):
+        self._free += 1  # unguarded fields stay free
+
+
+class AsyncDisciplined:
+    _GUARDED_FIELDS = {"_running": "_lock"}
+
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._running = {}
+
+    async def good_async(self, key):
+        async with self._lock:
+            self._running[key] = 1

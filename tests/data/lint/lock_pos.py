@@ -1,0 +1,42 @@
+"""C1 positive fixture: guarded fields touched OUTSIDE their lock.
+
+Each violation below is an expected `unlocked-field` finding; the test
+asserts the checker reports exactly these lines.
+"""
+
+import threading
+
+
+class RegistryStyle:
+    _GUARDED_FIELDS = {"_queue": "_lock", "_counter": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._counter = 0
+
+    def bad_write(self):
+        self._queue.append(1)  # VIOLATION: read outside the lock
+
+    def bad_mixed(self):
+        with self._lock:
+            self._counter += 1
+        self._counter += 1  # VIOLATION: second touch after release
+
+    def bad_closure(self):
+        with self._lock:
+            def later():
+                return self._queue  # VIOLATION: closure may outlive the lock
+
+            return later
+
+
+class CommentStyle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._holdback = []  # guarded-by: _lock
+
+    def bad_swap(self):
+        intake = self._holdback  # VIOLATION: unlocked alias grab
+        self._holdback = []  # VIOLATION: unlocked reset
+        return intake

@@ -93,3 +93,65 @@ def test_torl_rows(tok, tmp_path):
 def test_registry_dispatch_unknown():
     with pytest.raises(ValueError, match="unknown dataset"):
         get_custom_dataset("nope", type="definitely-not-registered")
+
+
+# ---------------------------------------------------------------------------
+# gsm8k_synth (VERDICT r5): the synthetic GSM8K generator + closed-vocab
+# tokenizer must round-trip through the REAL math reward — the module's
+# whole reason to exist is that GRPO against gsm8k_reward_fn can move
+# accuracy on it
+# ---------------------------------------------------------------------------
+
+
+def test_gsm8k_synth_tokenizer_round_trip():
+    from areal_tpu.dataset.gsm8k_synth import WordTokenizer, generate_problems
+
+    tok = WordTokenizer()
+    for item in generate_problems(64, seed=3):
+        # the solution must survive encode->decode verbatim enough that
+        # the \boxed{N} syntax is literally reproduced (no <unk> holes)
+        ids = tok.encode(item["solution"])
+        assert tok.unk_token_id not in ids, item["solution"]
+        assert f"\\boxed{{{item['answer']}}}" in tok.decode(ids)
+        # prompts round-trip too (chat template -> ids -> text)
+        pids = tok.apply_chat_template(item["messages"])
+        assert tok.unk_token_id not in pids
+        assert "User:" in tok.decode(pids)
+
+
+def test_gsm8k_synth_reward_fn_compatibility():
+    """The generator's solutions score 1.0 under gsm8k_reward_fn AFTER a
+    tokenizer round trip (the exact path RLVRWorkflow runs: completion
+    ids -> decode -> extract_answer -> math_equal), and corrupted answers
+    score 0.0."""
+    from areal_tpu.dataset.gsm8k_synth import WordTokenizer, generate_problems
+    from areal_tpu.reward.math_parser import gsm8k_reward_fn
+
+    tok = WordTokenizer()
+    for item in generate_problems(32, seed=7):
+        completion_ids = tok.encode(item["solution"])
+        completion = tok.decode(completion_ids)
+        assert gsm8k_reward_fn(
+            "", completion, [], completion_ids, item["answer"]
+        ) == 1.0, (item, completion)
+        wrong = str(int(item["answer"]) + 1)
+        assert gsm8k_reward_fn(
+            "", completion, [], completion_ids, wrong
+        ) == 0.0
+
+
+def test_gsm8k_synth_sft_example_masks_prompt():
+    from areal_tpu.dataset.gsm8k_synth import (
+        WordTokenizer,
+        generate_problems,
+        sft_example,
+    )
+
+    tok = WordTokenizer()
+    item = generate_problems(1, seed=11)[0]
+    ex = sft_example(tok, item)
+    n_prompt = len(tok.apply_chat_template(item["messages"]))
+    assert ex["input_ids"].shape == ex["loss_mask"].shape
+    assert ex["loss_mask"][:n_prompt].sum() == 0  # no loss on the prompt
+    assert ex["loss_mask"][n_prompt:].all()  # full loss on the solution
+    assert ex["input_ids"][-1] == tok.eos_token_id

@@ -8,6 +8,7 @@ x live-publish composition, the no-regression guarantee vs unclustered
 admission, steady-state compile-signature stability, and the r5 advice
 fixes (reservation off-by-one, holdback abort safety, match-window cap)."""
 
+import os
 import time
 
 import numpy as np
@@ -18,8 +19,22 @@ from areal_tpu.models import forward, init_params
 from areal_tpu.models.model_config import tiny_config
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _debug_locks():
+    """Abort-storm x live-publish composition runs with the runtime lock
+    assertions armed (areal-lint C1 acceptance): annotation drift raises
+    LockDisciplineError instead of racing silently."""
+    old = os.environ.get("AREAL_DEBUG_LOCKS")
+    os.environ["AREAL_DEBUG_LOCKS"] = "1"
+    yield
+    if old is None:
+        os.environ.pop("AREAL_DEBUG_LOCKS", None)
+    else:
+        os.environ["AREAL_DEBUG_LOCKS"] = old
+
+
 @pytest.fixture(scope="module")
-def setup():
+def setup(_debug_locks):
     import jax
 
     cfg = tiny_config(vocab_size=97, qkv_bias=True,
@@ -324,8 +339,10 @@ def test_abort_during_admit_pass_never_resurrects_holdback(setup):
     eng.step()
     eng._plan_clusters = orig
     # nothing lingers in holdback unfinished, and nobody ever gets a
-    # second terminal callback
-    assert not eng._holdback
+    # second terminal callback (guarded field: read under the lock, which
+    # the armed AREAL_DEBUG_LOCKS assertions enforce even for tests)
+    with eng._lock:
+        assert not eng._holdback
     for r in reqs:
         assert counts[r.rid] <= 1, r.rid
         if r.stop_reason == "abort":

@@ -9,7 +9,7 @@ import numpy as np
 from areal_tpu.api.config import GenerationHyperparameters
 from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.dataset.clevr import clevr_count_reward
-from areal_tpu.utils.mrope import mrope_position_ids
+from areal_tpu.models.vision import mrope_position_ids
 from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
 
 
@@ -66,9 +66,13 @@ def test_vision_workflow_plumbs_images():
 
 def test_mrope_position_ids():
     IMG = 151655
-    # text text [2x2 image = 4 tokens] text
+    # text text [2x2 merged image = 4 tokens] text; the serving-path
+    # implementation (models/vision.py) takes the grid in PATCHES, so a
+    # (1, 4, 4) patch grid at merge size 2 yields the 2x2 placeholder run
     ids = [1, 2] + [IMG] * 4 + [3]
-    pos = mrope_position_ids(ids, IMG, [(1, 2, 2)])
+    pos = mrope_position_ids(
+        np.asarray(ids), np.asarray([[1, 4, 4]]), IMG, spatial_merge_size=2
+    )
     # text advances all channels together
     np.testing.assert_array_equal(pos[:, 0], [0, 0, 0])
     np.testing.assert_array_equal(pos[:, 1], [1, 1, 1])
