@@ -41,6 +41,7 @@ KNOWN_RULES = frozenset(
         "guard-syntax",
         "host-sync",
         "host-item",
+        "host-upload",
         "unbucketed-shape",
         "async-blocking",
         "dead-module",

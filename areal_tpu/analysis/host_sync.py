@@ -15,7 +15,15 @@ serialise the device pipeline or mint new XLA programs mid-loop:
 - `unbucketed-shape`: a ``len(...)``/``.shape``-derived int flowing into a
   jitted call site without passing through ``round_up_to_bucket`` or a
   power-of-two ``bit_length`` ladder — every distinct value compiles a new
-  program (the recompile-storm class the bucket ladders exist to prevent).
+  program (the recompile-storm class the bucket ladders exist to prevent);
+- `host-upload`: ``jnp.asarray(self.<attr>)`` (or ``jnp.array`` /
+  ``jax.device_put`` of an instance attribute) passed directly into a
+  jitted call — persistent engine state re-uploaded host->device on every
+  dispatch.  Per-batch locals are exempt (they are genuinely new data);
+  instance attributes are standing state that belongs in a device-resident
+  mirror synced only when host bookkeeping mutates it (the ISSUE 5 decode
+  loop is the model: `_sync_device_state` on dirty, device->device chaining
+  otherwise).
 
 The tracking is per-function and source-ordered: a name assigned from a
 jitted call is device-resident until reassigned from a host expression.
@@ -30,6 +38,8 @@ _BUCKETING_MARKERS = ("round_up_to_bucket", "bit_length")
 _HOST_CONVERTERS = {"float", "int"}
 _NP_CONVERTERS = {("np", "asarray"), ("np", "array"), ("numpy", "asarray"),
                   ("numpy", "array")}
+_UPLOADERS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+              "jax.numpy.array", "jax.device_put"}
 
 
 def _dotted(node: ast.AST) -> str:
@@ -66,6 +76,23 @@ def _is_host_converter(call: ast.Call) -> bool:
     if isinstance(f, ast.Name) and f.id in _HOST_CONVERTERS:
         return True
     return _is_np_converter(call)
+
+
+def _is_state_upload(arg: ast.AST) -> Optional[str]:
+    """`jnp.asarray(self.<attr>, ...)`-shaped argument -> the attr path, or
+    None.  Only instance attributes count: per-batch locals are new data,
+    `self.*` is standing state that belongs in a device-resident mirror."""
+    if not (isinstance(arg, ast.Call) and _dotted(arg.func) in _UPLOADERS):
+        return None
+    if not arg.args:
+        return None
+    src = arg.args[0]
+    node = src
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and node is not src:
+        return ast.unparse(src)
+    return None
 
 
 def _contains(node: ast.AST, pred) -> bool:
@@ -174,6 +201,23 @@ def _scan_function(sf: SourceFile, fn, findings: List[Finding]) -> None:
                 for arg in list(node.args) + [
                     kw.value for kw in node.keywords
                 ]:
+                    attr = _is_state_upload(arg)
+                    if attr is not None:
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    "host-upload",
+                                    sf.rel,
+                                    arg.lineno,
+                                    f"`{attr}` re-uploaded host->device on "
+                                    "every dispatch — persistent engine "
+                                    "state belongs in a device-resident "
+                                    "mirror synced only when the host "
+                                    "mutates it",
+                                ),
+                            )
+                        )
                     hazard = None
                     if isinstance(arg, ast.Name) and arg.id in shapeish:
                         hazard = arg.id

@@ -243,6 +243,17 @@ class GenServerConfig:
     random_seed: int = 1
     # KV cache dtype; bf16 default, fp8-style int8 quantization optional later.
     kv_dtype: str = "bfloat16"
+    # Tiered decode (ISSUE 5): decode attention reads a bucketed key window
+    # over the occupied span instead of the full max_context_len ceiling.
+    decode_window: bool = True
+    # Number of length-cohort slot tiers (1 = single cohort).  >1 splits the
+    # slot grid into contiguous blocks with ascending length ceilings so a
+    # long rollout does not inflate the short cohort's attended window;
+    # explicit layouts override via decode_tier_lens/decode_tier_slots
+    # (parallel lists: per-tier length ceiling / slot count).
+    decode_tiers: int = 1
+    decode_tier_lens: List[int] = field(default_factory=list)
+    decode_tier_slots: List[int] = field(default_factory=list)
 
     @staticmethod
     def build_cmd(
@@ -263,6 +274,19 @@ class GenServerConfig:
             f"--max-seq-len={config.max_context_len}",
             f"--tp={max(1, config.mesh.tensor_parallel_size)}",
         ]
+        if not config.decode_window:
+            args.append("--no-decode-window")
+        if config.decode_tiers > 1:
+            args.append(f"--decode-tiers={config.decode_tiers}")
+        if config.decode_tier_lens:
+            args.append(
+                "--decode-tier-lens="
+                + ",".join(str(x) for x in config.decode_tier_lens)
+            )
+            args.append(
+                "--decode-tier-slots="
+                + ",".join(str(x) for x in config.decode_tier_slots)
+            )
         if port:
             args.append(f"--port={port}")
         return " ".join(args)

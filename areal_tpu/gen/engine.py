@@ -61,6 +61,29 @@ Design for XLA's static shapes:
   prefixes compose with the live weight swap exactly like retained ones
   (strict mode zeroes both).  This is the in-engine counterpart of
   SGLang's RadixAttention / vLLM's shared PagedAttention blocks.
+- **Tiered decode** (ISSUE 5): decode used to attend over the full
+  `max_seq_len` cache width for every slot on every step, so steady-state
+  cost scaled with the configured ceiling, not with what slots hold.  Now
+  every decode dispatch carries a STATIC bucketed `key_window` K (the
+  same pow2 ladder as prompt buckets — zero new XLA signatures in steady
+  state) bounding attention reads, masks, and the cache write to the
+  occupied span.  Because one long slot would inflate K for the whole
+  grid, the slot grid partitions into **length-cohort tiers** — static
+  contiguous slot blocks, `decode_tiers`/`decode_tier_lens`/
+  `decode_tier_slots` — and `step()` runs one decode dispatch per
+  non-empty tier with that tier's own K.  Admission places requests by
+  prompt + `max_new_tokens` budget; a slot that outgrows its cohort
+  mid-generation migrates to a roomier tier via a device-side cache-row
+  copy (ops/kv_copy.py) or, when nothing is free, simply grows its own
+  tier's K bucket (ceilings are placement hints, never correctness).
+  Decode sampling is counter-keyed per slot (fold(decode_key, stream_id,
+  position)) so the token streams are bit-identical however the grid is
+  partitioned — the tiered-vs-untiered parity contract.  `lengths`,
+  `rope_pos`, `last_tokens` and the sampling params live device-resident
+  between chunks (host mirrors kept for bookkeeping; re-synced only when
+  admission/free/migration dirties them).  This is the slot-grid analogue
+  of vLLM's block-granular PagedAttention and Sarathi-Serve's principle
+  that steady-state serving cost should track occupied context.
 """
 
 # areal-lint: hot-path
@@ -78,8 +101,9 @@ from jax.sharding import PartitionSpec as P
 
 from areal_tpu.analysis.lockcheck import lock_guarded
 
-from areal_tpu.gen.sampling import sample_tokens
+from areal_tpu.gen.sampling import sample_tokens, sample_tokens_keyed
 from areal_tpu.models.model_config import TransformerConfig
+from areal_tpu.ops.kv_copy import copy_kv_prefix
 from areal_tpu.models.transformer import (
     forward_decode,
     forward_prefill,
@@ -103,6 +127,37 @@ def _lcp_ids(a: List[int], b: List[int]) -> int:
         return 0
     neq = np.asarray(a[:m], np.int64) != np.asarray(b[:m], np.int64)
     return int(neq.argmax()) if neq.any() else m
+
+
+def plan_decode_tiers(
+    n_slots: int,
+    max_seq_len: int,
+    n_tiers: int,
+    quantum: int = 128,
+) -> tuple:
+    """Default length-cohort layout: (tier ceilings, slots per tier).
+
+    Ceilings double up to `max_seq_len` (each at least 2 x quantum so the
+    lowest cohort still spans a few buckets); slot counts halve away from
+    tier 0 — the short cohort is where most rollouts live — with the last
+    two tiers equal so the counts sum exactly:
+        n_slots=64, n_tiers=3, max=16384 -> lens (4096, 8192, 16384),
+        slots (32, 16, 16).
+    """
+    if n_tiers <= 1:
+        return [max_seq_len], [n_slots]
+    if n_slots >> (n_tiers - 1) < 1:
+        raise ValueError(
+            f"decode_tiers={n_tiers} needs n_slots >= {1 << (n_tiers - 1)}"
+        )
+    slots = [n_slots >> (i + 1) for i in range(n_tiers - 1)]
+    slots.append(n_slots - sum(slots))  # tier 0 largest block
+    lens = [
+        max(2 * quantum, max_seq_len >> (n_tiers - 1 - i))
+        for i in range(n_tiers)
+    ]
+    lens[-1] = max_seq_len
+    return lens, slots
 
 
 @dataclass
@@ -172,6 +227,10 @@ class GenEngine:
         share_min_tokens: Optional[int] = None,
         group_hold_s: float = 0.05,
         match_window: Optional[int] = None,
+        decode_window: bool = True,
+        decode_tiers: int = 1,
+        decode_tier_lens: Optional[List[int]] = None,
+        decode_tier_slots: Optional[List[int]] = None,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -307,6 +366,57 @@ class GenEngine:
         self._parked_free: Optional[frozenset] = None
         self._parked_until: float = 0.0
         self._slot_vlm = np.zeros(S, bool)  # VLM slots never reuse (mrope)
+        # --- tiered decode (ISSUE 5) -----------------------------------
+        # length-cohort tiers: contiguous slot blocks [tier_start[t],
+        # tier_start[t] + tier_size[t]) with ascending ceilings
+        # tier_bounds[t] (the last always max_seq_len).  Ceilings steer
+        # admission placement and migration; correctness never depends on
+        # them — a cohort outlier just grows its own tier's K bucket.
+        self.decode_window = decode_window
+        if decode_tier_lens is not None or decode_tier_slots is not None:
+            if not (decode_tier_lens and decode_tier_slots):
+                raise ValueError(
+                    "decode_tier_lens and decode_tier_slots come together"
+                )
+            if len(decode_tier_lens) != len(decode_tier_slots):
+                raise ValueError("tier lens/slots length mismatch")
+        else:
+            decode_tier_lens, decode_tier_slots = plan_decode_tiers(
+                n_slots, max_seq_len, max(1, decode_tiers), prompt_bucket
+            )
+        if sum(decode_tier_slots) != n_slots:
+            raise ValueError(
+                f"decode_tier_slots {decode_tier_slots} must sum to "
+                f"n_slots={n_slots}"
+            )
+        if list(decode_tier_lens) != sorted(decode_tier_lens):
+            raise ValueError("decode_tier_lens must ascend")
+        self.tier_bounds = [
+            min(int(b), max_seq_len) for b in decode_tier_lens
+        ]
+        self.tier_bounds[-1] = max_seq_len
+        self.tier_size = [int(c) for c in decode_tier_slots]
+        self.tier_start = list(np.cumsum([0] + self.tier_size[:-1]))
+        self.n_tiers = len(self.tier_size)
+        self.slot_tier = np.zeros(S, np.int32)
+        for t in range(self.n_tiers):
+            lo = self.tier_start[t]
+            self.slot_tier[lo : lo + self.tier_size[t]] = t
+        self.slot_tier[n_slots] = self.n_tiers - 1  # scratch: never decoded
+        # decode sampling is counter-keyed: key = fold(fold(_decode_key,
+        # stream_id), cache position).  stream_ids are assigned at
+        # admission in arrival order — identical however the grid is
+        # tiered — so token streams are partition-invariant AND fresh per
+        # request (no gumbel-noise reuse across requests in one slot).
+        self._decode_key = jax.random.fold_in(jax.random.PRNGKey(seed), 0xD)
+        self.stream_ids = np.zeros(S, np.int32)
+        self._next_stream = 1
+        # device-resident decode state (tokens/lengths/rope_pos/active/
+        # sampling params): uploaded only when host bookkeeping diverges
+        # (admission, free, migration, abort) — steady-state chunks flow
+        # device->device with zero uploads
+        self._dev_state: Optional[Dict[str, jax.Array]] = None
+        self._state_dirty = True
         # weight version of the OLDEST K/V in each slot's valid prefix:
         # retained and shared prefixes propagate it, so strict-version
         # audits can prove no pre-swap KV seeds post-swap decoding
@@ -326,6 +436,17 @@ class GenEngine:
             # keeps this at 0; a rising count means the TTL is too short
             # (or clients stopped resubmitting)
             "reservations_lapsed": 0,
+            # tiered decode (ISSUE 5): cohort migrations (device-side
+            # cache-row copies to a roomier tier) and the attended-span
+            # accounting — attended/ceiling column-steps, whose ratio is
+            # decode_attended_fraction (1.0 = decode pays the full
+            # max_seq_len ceiling; the window's whole point is << 1)
+            "tier_migrations": 0,
+            "decode_attended_cols": 0,
+            "decode_ceiling_cols": 0,
+            # host->device re-uploads of the decode state (dirtied by
+            # admission/free/migration); steady state adds none
+            "state_syncs": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -355,26 +476,53 @@ class GenEngine:
             return tok, logp, cache
 
         def _decode_chunk(
-            params, cache, tokens, lengths, rope_pos, rng, temp, tp, tk, n
+            params, cache, tokens, lengths, rope_pos, streams, active,
+            temp, tp, tk, decode_key, n, base, size, key_window,
         ):
-            def body(carry, _):
-                cache, tokens, lengths, rope_pos, rng = carry
-                logits, cache = forward_decode(
-                    params, cfg, tokens, lengths, cache,
-                    rope_positions=rope_pos,
-                )
-                rng, sub = jax.random.split(rng)
-                tok, logp = sample_tokens(
-                    logits.astype(jnp.float32), sub, temp, tk, tp
-                )
-                return (cache, tok, lengths + 1, rope_pos + 1, rng), (tok, logp)
+            """Advance ONE length-cohort tier — the `size` slots at cache
+            rows [base, base+size) — by `n` fused decode+sample steps.
+            `tokens`/`lengths`/`rope_pos` are the FULL device-resident
+            state arrays (donated; returned with the block advanced), so
+            consecutive tier dispatches chain device->device with no host
+            upload.  `key_window` statically bounds the attended span
+            (bucket ladder); `active` drops idle slots' cache writes."""
+            tok_b = jax.lax.slice_in_dim(tokens, base, base + size)
+            len_b = jax.lax.slice_in_dim(lengths, base, base + size)
+            rp_b = jax.lax.slice_in_dim(rope_pos, base, base + size)
+            act_b = jax.lax.slice_in_dim(active, base, base + size)
+            temp_b = jax.lax.slice_in_dim(temp, base, base + size)
+            tp_b = jax.lax.slice_in_dim(tp, base, base + size)
+            tk_b = jax.lax.slice_in_dim(tk, base, base + size)
+            st_b = jax.lax.slice_in_dim(streams, base, base + size)
+            slot_keys = jax.vmap(
+                lambda s: jax.random.fold_in(decode_key, s)
+            )(st_b)
 
-            (cache, _, _, _, _), (toks, logps) = jax.lax.scan(
-                body, (cache, tokens, lengths, rope_pos, rng), None, length=n
+            def body(carry, _):
+                cache, tok_b, len_b, rp_b = carry
+                logits, cache = forward_decode(
+                    params, cfg, tok_b, len_b, cache,
+                    rope_positions=rp_b, key_window=key_window,
+                    slot_base=base, active=act_b,
+                )
+                # counter-based keys: (stream, cache position) — unique
+                # per generated token, independent of how the grid is
+                # partitioned into dispatches
+                keys = jax.vmap(jax.random.fold_in)(slot_keys, len_b)
+                tok, logp = sample_tokens_keyed(
+                    logits.astype(jnp.float32), keys, temp_b, tk_b, tp_b
+                )
+                return (cache, tok, len_b + 1, rp_b + 1), (tok, logp)
+
+            (cache, tok_b, len_b, rp_b), (toks, logps) = jax.lax.scan(
+                body, (cache, tok_b, len_b, rp_b), None, length=n
             )
+            tokens = jax.lax.dynamic_update_slice_in_dim(tokens, tok_b, base, 0)
+            lengths = jax.lax.dynamic_update_slice_in_dim(lengths, len_b, base, 0)
+            rope_pos = jax.lax.dynamic_update_slice_in_dim(rope_pos, rp_b, base, 0)
             # one fused download: tokens are exactly representable in f32
-            out = jnp.stack([toks.astype(jnp.float32), logps])  # [2, n, S]
-            return out, cache
+            out = jnp.stack([toks.astype(jnp.float32), logps])  # [2, n, size]
+            return out, cache, tokens, lengths, rope_pos
 
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
         # the suffix program carries the cross-slot prefix fan-out fused in
@@ -385,8 +533,16 @@ class GenEngine:
         self._suffix_prefill_fn = jax.jit(
             _suffix_prefill, static_argnums=(11, 12), donate_argnums=(1,)
         )
-        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(9,),
-                                  donate_argnums=(1,))
+        # signature family: (tier block, chunk, K bucket) — tiers and
+        # chunk are fixed per engine, K rides the pow2 prompt-bucket
+        # ladder, so steady state compiles O(tiers x log(M/quantum))
+        # programs and then mints none (pinned by test)
+        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(11, 12, 13, 14),
+                                  donate_argnums=(1, 2, 3, 4))
+        # tier migration: batched device-side cache-row copy (the group
+        # fan-out machinery reused verbatim); block is bucketed
+        self._kv_copy_fn = jax.jit(copy_kv_prefix, static_argnums=(3,),
+                                   donate_argnums=(0,))
         self._init_vlm()
 
     def _init_vlm(self) -> None:
@@ -498,6 +654,7 @@ class GenEngine:
                     ):
                         self._reserved_until[s] = deadline
                     n += 1
+            self._state_dirty = True
             for req in self._holdback:
                 req.finish(reason)
                 n += 1
@@ -661,6 +818,8 @@ class GenEngine:
         self.abort_all("abort")
         self.cache = None
         self._standby = None
+        self._dev_state = None  # rebuilt from host mirrors at restage
+        self._state_dirty = True
         self.retained_len[:] = 0  # cache is gone; no prefix survives
         self._reserved_until[:] = 0.0
         self.kv_version[:] = self.version
@@ -1010,15 +1169,39 @@ class GenEngine:
             if 0.0 < self._reserved_until[s] <= now:
                 self._reserved_until[s] = 0.0
                 self.stats["reservations_lapsed"] += 1
-        open_slots = sorted(
+        # open slots grouped by length-cohort tier, least-valuable retained
+        # cache first within each tier; a request lands in the smallest
+        # tier whose ceiling covers its prompt + max_new_tokens budget,
+        # falling UP to roomier tiers when its cohort is full and DOWN
+        # (optimistic placement, migration may follow) only as a last
+        # resort — admission capacity is unchanged: a request is parked
+        # only when NO open slot exists anywhere
+        open_by_tier: List[List[int]] = [[] for _ in range(self.n_tiers)]
+        for s in sorted(
             (s for s in free_set if self._reserved_until[s] <= now),
             key=lambda s: int(self.retained_len[s]),
-        )
+        ):
+            open_by_tier[int(self.slot_tier[s])].append(s)
+        n_open = sum(len(t) for t in open_by_tier)
+
+        def _pick_slot(req: GenRequest) -> Optional[int]:
+            budget = len(req.input_ids) + req.max_new_tokens + 1
+            pref = next(
+                (t for t, b in enumerate(self.tier_bounds) if b >= budget),
+                self.n_tiers - 1,
+            )
+            for t in list(range(pref, self.n_tiers)) + list(
+                range(pref - 1, -1, -1)
+            ):
+                if open_by_tier[t]:
+                    return open_by_tier[t].pop(0)
+            return None
+
         leftover: List[GenRequest] = list(held)
         for i, (req, is_vlm) in enumerate(entries):
             if i in matched:
                 continue
-            if not open_slots:
+            if not n_open:
                 leftover.append(req)
                 if req.group_id:
                     # the group already had its co-resident window; a later
@@ -1027,7 +1210,8 @@ class GenEngine:
                     # re-parking them for the hold TTL
                     self._group_first_seen[req.group_id] = 0.0
                 continue
-            s = open_slots.pop(0)
+            s = _pick_slot(req)
+            n_open -= 1
             cid = cluster_of.get(i)
             if cid is not None and clusters[cid].get("rep_slot") is not None:
                 shared_admitted.append(
@@ -1128,8 +1312,13 @@ class GenEngine:
                 self._reserved_until[s] = 0.0
                 self._slot_vlm[s] = False
                 self.kv_version[s] = self.version
+                # decode-key stream: assigned in batch (arrival) order so
+                # sampled streams are identical however slots are tiered
+                self.stream_ids[s] = self._next_stream
+                self._next_stream += 1
                 n = len(req.input_ids)
                 self.seq_tokens[s, :n] = req.input_ids
+            self._state_dirty = True
         for i, (s, req) in enumerate(admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
@@ -1234,7 +1423,10 @@ class GenEngine:
                 self.kv_version[s] = min(
                     int(self.kv_version[kv_src]), self.version
                 )
+                self.stream_ids[s] = self._next_stream
+                self._next_stream += 1
                 self.seq_tokens[s, :n_total] = req.input_ids
+            self._state_dirty = True
         for i, (s, req, _, _, _) in enumerate(batch):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
@@ -1379,6 +1571,9 @@ class GenEngine:
                 self.retained_len[s] = 0
                 self._reserved_until[s] = 0.0
                 self.kv_version[s] = self.version
+                self.stream_ids[s] = self._next_stream
+                self._next_stream += 1
+            self._state_dirty = True
         for i, (s, req) in enumerate(vlm_admitted):
             self._record_token(s, int(toks[i]), float(logps[i]))
 
@@ -1413,13 +1608,143 @@ class GenEngine:
             # prefix-reuse admission; the pending last token's K/V was never
             # written, so it is excluded
             self.retained_len[s] = 0 if self._slot_vlm[s] else self.lengths[s]
+            self._state_dirty = True
         if req is not None:
             req.finish(reason)
 
+    def tier_occupancy(self) -> List[int]:
+        """Active slots per length-cohort tier (metrics surface)."""
+        return [
+            sum(
+                self.slot_req[s] is not None
+                for s in range(
+                    self.tier_start[t], self.tier_start[t] + self.tier_size[t]
+                )
+            )
+            for t in range(self.n_tiers)
+        ]
+
+    def decode_attended_fraction(self) -> float:
+        """Attended span / configured ceiling over all decode dispatches:
+        1.0 means decode paid the full `max_seq_len` width (the pre-window
+        behavior); the bucketed key-window drives this toward
+        occupied/ceiling."""
+        ceiling = self.stats["decode_ceiling_cols"]
+        return (
+            self.stats["decode_attended_cols"] / ceiling if ceiling else 1.0
+        )
+
+    def _plan_migrations(self, n: int) -> None:
+        """Move slots about to outgrow their tier's ceiling into a roomier
+        cohort: ONE batched device-side cache-row copy (ops/kv_copy.py,
+        bucketed block + pow2-padded rows — the fan-out program family, no
+        new signature class), then the host state follows.  The source slot
+        frees with its prefix retained, so multi-turn matching still finds
+        it.  When nothing roomier is free the slot simply stays — its own
+        tier's K bucket grows to cover it (the top-tier fallback: ceilings
+        are placement hints, never correctness)."""
+        if self.n_tiers == 1:
+            return
+        now = time.monotonic()
+        free_by_tier: List[List[int]] = [[] for _ in range(self.n_tiers)]
+        for s in range(self.n_slots):
+            if self.slot_req[s] is None and self._reserved_until[s] <= now:
+                # prefer overwriting the least valuable retained cache
+                free_by_tier[int(self.slot_tier[s])].append(s)
+        for t in range(self.n_tiers):
+            free_by_tier[t].sort(key=lambda s: int(self.retained_len[s]))
+        moves: List[tuple] = []  # (src, dst)
+        for s in range(self.n_slots):
+            req = self.slot_req[s]
+            t = int(self.slot_tier[s])
+            if req is None or t == self.n_tiers - 1:
+                continue
+            if int(self.lengths[s]) + n < self.tier_bounds[t]:
+                continue  # still inside its cohort for this whole chunk
+            remaining = max(0, req.max_new_tokens - len(req.output_tokens))
+            need = min(int(self.lengths[s]) + remaining + 1, self.max_seq_len)
+            dst = None
+            for u in range(t + 1, self.n_tiers):
+                if self.tier_bounds[u] >= min(
+                    need, int(self.lengths[s]) + n + 1
+                ) and free_by_tier[u]:
+                    # smallest adequate tier; the top tier always qualifies
+                    if self.tier_bounds[u] >= need or u == self.n_tiers - 1:
+                        dst = free_by_tier[u].pop(0)
+                        break
+            if dst is not None:
+                moves.append((s, dst))
+        if not moves:
+            return
+        block = round_up_to_bucket(
+            int(max(self.lengths[s] for s, _ in moves)),
+            self.prompt_bucket,
+            self.max_seq_len,
+        )
+        d = 1 << (len(moves) - 1).bit_length()
+        src = np.full(d, self.n_slots, np.int32)  # pad: scratch self-copy
+        dst_a = np.full(d, self.n_slots, np.int32)
+        for i, (s, t_) in enumerate(moves):
+            src[i] = s
+            dst_a[i] = t_
+        self.cache = self._kv_copy_fn(
+            self.cache, jnp.asarray(src), jnp.asarray(dst_a), block
+        )
+        with self._lock:
+            for s, dst in moves:
+                req = self.slot_req[s]
+                if req is None:  # aborted while the copy was in flight
+                    continue
+                self.slot_req[dst] = req
+                self.slot_req[s] = None
+                for arr in (
+                    self.lengths, self.rope_pos, self.last_tokens,
+                    self.temperature, self.top_p, self.top_k,
+                    self.kv_version, self.stream_ids, self._slot_vlm,
+                ):
+                    arr[dst] = arr[s]
+                self.seq_tokens[dst] = self.seq_tokens[s]
+                self.retained_len[dst] = 0
+                self._reserved_until[dst] = 0.0
+                # the source keeps its cache row: it frees as a retained
+                # prefix (the migrated request's transcript so far)
+                self.retained_len[s] = (
+                    0 if self._slot_vlm[s] else self.lengths[s]
+                )
+                self._slot_vlm[s] = False
+                self.stats["tier_migrations"] += 1
+            self._state_dirty = True
+
+    def _sync_device_state(self) -> None:
+        """(Re)build the device-resident decode state from the host
+        bookkeeping mirrors.  Runs only when a host-side mutation
+        (admission, free, migration, abort) dirtied the mirrors — the
+        steady-state decode loop chains the previous chunk's outputs
+        instead (C2 host-upload discipline: uploads live HERE, never per
+        dispatch)."""
+        active = np.asarray(
+            [r is not None for r in self.slot_req], bool
+        )
+        self._dev_state = {
+            "tokens": jnp.asarray(self.last_tokens),
+            "lengths": jnp.asarray(self.lengths),
+            "rope_pos": jnp.asarray(self.rope_pos),
+            "streams": jnp.asarray(self.stream_ids),
+            "active": jnp.asarray(active),
+            "temp": jnp.asarray(self.temperature),
+            "top_p": jnp.asarray(self.top_p),
+            "top_k": jnp.asarray(self.top_k),
+        }
+        self._state_dirty = False
+        self.stats["state_syncs"] += 1
+
     def step(self, chunk: Optional[int] = None) -> int:
         """Admit pending prompts, then advance every active slot by up to
-        `chunk` tokens in one device program.  Returns generated-token count
-        actually delivered (overshoot past stop conditions excluded).
+        `chunk` tokens — ONE fused device program per non-empty
+        length-cohort tier, each bounded to its own bucketed `key_window`
+        (ISSUE 5: decode attention reads track the occupied span, not the
+        `max_seq_len` ceiling).  Returns generated-token count actually
+        delivered (overshoot past stop conditions excluded).
 
         A slot at its cache limit no longer clamps the whole grid's chunk
         (VERDICT r3 weak #3): the decode kernel clamps that slot's writes to
@@ -1429,29 +1754,73 @@ class GenEngine:
         token matrices, not a Python token loop (slot grids of 64-256 would
         otherwise pay O(slots x chunk) interpreter overhead per step)."""
         self._admit()
+        n = chunk or self.decode_chunk
+        self._plan_migrations(n)
         with self._lock:
             active = [s for s in range(self.n_slots) if self.slot_req[s] is not None]
         if not active:
             return 0
-        n = chunk or self.decode_chunk
-        self.rng, sub = jax.random.split(self.rng)
-        out, self.cache = self._decode_fn(
-            self.params,
-            self.cache,
-            jnp.asarray(self.last_tokens),
-            jnp.asarray(self.lengths),
-            jnp.asarray(self.rope_pos),
-            sub,
-            jnp.asarray(self.temperature),
-            jnp.asarray(self.top_p),
-            jnp.asarray(self.top_k),
-            n,
-        )
-        # areal-lint: disable=host-sync delivery point: ONE fused download per decode chunk is the designed host round-trip cadence
-        out = np.asarray(out)  # [2, n, S]
-        self.stats["decode_calls"] += 1
-        toks = out[0].astype(np.int32)
-        logps = out[1]
+        if self._dev_state is None or self._state_dirty:
+            self._sync_device_state()
+        st = self._dev_state
+        S = self.n_slots + 1
+        # per-tier dispatch: only tiers holding an active slot run; each
+        # gets a key window bucketed from ITS occupants' spans
+        tier_active = [[] for _ in range(self.n_tiers)]
+        for s in active:
+            tier_active[int(self.slot_tier[s])].append(s)
+        M = self.max_seq_len
+        dev_outs: List[tuple] = []  # (tier, device out) — fetch after all dispatch
+        try:
+            for t in range(self.n_tiers):
+                if not tier_active[t]:
+                    continue
+                if self.decode_window:
+                    span = int(max(self.lengths[s] for s in tier_active[t]))
+                    key_window = round_up_to_bucket(
+                        span + n, self.prompt_bucket, M
+                    )
+                else:
+                    key_window = M
+                out_t, self.cache, tok, ln, rp = self._decode_fn(
+                    self.params,
+                    self.cache,
+                    st["tokens"],
+                    st["lengths"],
+                    st["rope_pos"],
+                    st["streams"],
+                    st["active"],
+                    st["temp"],
+                    st["top_p"],
+                    st["top_k"],
+                    self._decode_key,
+                    n,
+                    self.tier_start[t],
+                    self.tier_size[t],
+                    key_window,
+                )
+                st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
+                self.stats["decode_calls"] += 1
+                self.stats["decode_attended_cols"] += (
+                    key_window * self.tier_size[t] * n
+                )
+                self.stats["decode_ceiling_cols"] += (
+                    M * self.tier_size[t] * n
+                )
+                dev_outs.append((t, out_t))
+        except Exception:
+            # a failed dispatch may have consumed (donated) device state
+            self._dev_state = None
+            self._state_dirty = True
+            raise
+        toks = np.zeros((n, S), np.int32)
+        logps = np.zeros((n, S), np.float32)
+        for t, out_t in dev_outs:
+            # areal-lint: disable=host-sync delivery point: ONE fused download per tier chunk is the designed host round-trip cadence
+            arr = np.asarray(out_t)  # [2, n, tier_size]
+            lo = self.tier_start[t]
+            toks[:, lo : lo + self.tier_size[t]] = arr[0].astype(np.int32)
+            logps[:, lo : lo + self.tier_size[t]] = arr[1]
 
         delivered = 0
         to_finish: List[tuple] = []
@@ -1514,6 +1883,10 @@ class GenEngine:
                         0 if self._slot_vlm[s] else self.lengths[s]
                     )
                     to_finish.append((req, reason))
+            if to_finish:
+                # host mirrors diverged from the device state (stop
+                # trimming); resync before the next chunk
+                self._state_dirty = True
         for req, reason in to_finish:
             req.finish(reason)
         return delivered

@@ -20,16 +20,16 @@ TOPK_WINDOW = 64
 NEG_INF = -1e30
 
 
-def sample_tokens(
+def _masked_window(
     logits: jax.Array,  # [S, V] fp32
-    rng: jax.Array,
     temperature: jax.Array,  # [S]; 0 = greedy
     top_k: jax.Array,  # [S] int32; 0 = disabled
     top_p: jax.Array,  # [S]; 1.0 = disabled
 ):
-    """Returns (tokens [S], logprobs [S]) — logprob of the sampled token
-    under the *unmodified* (temperature-scaled) distribution, matching what
-    inference servers report and what decoupled PPO consumes."""
+    """Shared masking front half: temperature-scale, take the static
+    candidate window, apply top-k/top-p.  Returns
+    (scaled [S, V], masked window logits [S, W], window idx [S, W], greedy
+    [S])."""
     S, V = logits.shape
     logits = logits.astype(jnp.float32)
     greedy = temperature <= 0.0
@@ -50,7 +50,28 @@ def sample_tokens(
     keep &= (cum - win_probs) < top_p[:, None]  # keep first token exceeding p
     keep |= ranks == 0  # top_p=0 must mean near-greedy, never mask everything
     masked = jnp.where(keep, win_logits, NEG_INF)
+    return scaled, masked, win_idx, greedy
 
+
+def _token_logprob(scaled: jax.Array, tokens: jax.Array) -> jax.Array:
+    logz = jax.nn.logsumexp(scaled, axis=-1)
+    tok_logit = jnp.take_along_axis(scaled, tokens[:, None], axis=-1)[:, 0]
+    return tok_logit - logz
+
+
+def sample_tokens(
+    logits: jax.Array,  # [S, V] fp32
+    rng: jax.Array,
+    temperature: jax.Array,  # [S]; 0 = greedy
+    top_k: jax.Array,  # [S] int32; 0 = disabled
+    top_p: jax.Array,  # [S]; 1.0 = disabled
+):
+    """Returns (tokens [S], logprobs [S]) — logprob of the sampled token
+    under the *unmodified* (temperature-scaled) distribution, matching what
+    inference servers report and what decoupled PPO consumes."""
+    scaled, masked, win_idx, greedy = _masked_window(
+        logits, temperature, top_k, top_p
+    )
     rng_win, rng_full = jax.random.split(rng)
     choice = jax.random.categorical(rng_win, masked, axis=-1)  # [S] window index
     sampled = jnp.take_along_axis(win_idx, choice[:, None], axis=-1)[:, 0]
@@ -64,7 +85,38 @@ def sample_tokens(
     )
     sampled = jnp.where(unrestricted, full_sampled, sampled)
     tokens = jnp.where(greedy, win_idx[:, 0], sampled)
+    return tokens, _token_logprob(scaled, tokens)
 
-    logz = jax.nn.logsumexp(scaled, axis=-1)
-    tok_logit = jnp.take_along_axis(scaled, tokens[:, None], axis=-1)[:, 0]
-    return tokens, tok_logit - logz
+
+def sample_tokens_keyed(
+    logits: jax.Array,  # [S, V] fp32
+    keys: jax.Array,  # [S] per-slot PRNG keys (vmapped leading axis)
+    temperature: jax.Array,  # [S]; 0 = greedy
+    top_k: jax.Array,  # [S] int32; 0 = disabled
+    top_p: jax.Array,  # [S]; 1.0 = disabled
+):
+    """`sample_tokens` with one independent PRNG key PER ROW.
+
+    The batch-keyed sampler draws its noise as one [S, ...] tensor, so a
+    row's draw depends on the batch SHAPE — splitting the slot grid into
+    length-cohort tiers (ISSUE 5) would change every slot's stream.  Keyed
+    per row (the engine derives key = fold(decode_key, stream_id, position)
+    — a counter-based scheme), a slot's tokens are a function of its own
+    (key, logits) only, so any partitioning of slots into decode dispatches
+    yields identical streams: the tiered-vs-untiered parity contract."""
+    scaled, masked, win_idx, greedy = _masked_window(
+        logits, temperature, top_k, top_p
+    )
+    split2 = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [S, 2, ...]
+    rng_win, rng_full = split2[:, 0], split2[:, 1]
+    choice = jax.vmap(jax.random.categorical)(rng_win, masked)  # [S]
+    sampled = jnp.take_along_axis(win_idx, choice[:, None], axis=-1)[:, 0]
+    unrestricted = (top_k <= 0) & (top_p >= 1.0)
+    full_sampled = jax.lax.cond(
+        jnp.any(unrestricted),
+        lambda: jax.vmap(jax.random.categorical)(rng_full, scaled),
+        lambda: sampled,
+    )
+    sampled = jnp.where(unrestricted, full_sampled, sampled)
+    tokens = jnp.where(greedy, win_idx[:, 0], sampled)
+    return tokens, _token_logprob(scaled, tokens)

@@ -398,6 +398,17 @@ class GenServer:
                 "reservations_lapsed": self.engine.stats[
                     "reservations_lapsed"
                 ],
+                # tiered decode (ISSUE 5): attended span / configured
+                # ceiling over all decode dispatches (1.0 = paying the
+                # full max_seq_len width), per-cohort occupancy, and
+                # cross-tier cache-row migrations
+                "decode_attended_fraction": round(
+                    self.engine.decode_attended_fraction(), 4
+                ),
+                "tier_occupancy": self.engine.tier_occupancy(),
+                "tier_slots": list(self.engine.tier_size),
+                "tier_lens": list(self.engine.tier_bounds),
+                "tier_migrations": self.engine.stats["tier_migrations"],
             }
         )
 
@@ -458,7 +469,33 @@ def main():
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
     p.add_argument("--server-idx", type=int, default=0)
+    p.add_argument("--no-decode-window", action="store_true",
+                   help="disable the bucketed decode key window (attend "
+                        "the full max-seq-len cache width — the legacy "
+                        "ceiling-bound behavior)")
+    p.add_argument("--decode-tiers", type=int, default=1,
+                   help="number of length-cohort slot tiers; >1 keeps one "
+                        "long rollout from inflating the short cohort's "
+                        "attended window")
+    p.add_argument("--decode-tier-lens", default="",
+                   help="explicit per-tier length ceilings (comma list, "
+                        "ascending; overrides --decode-tiers)")
+    p.add_argument("--decode-tier-slots", default="",
+                   help="explicit per-tier slot counts (comma list, must "
+                        "sum to --n-slots)")
     args = p.parse_args()
+    tier_kw = dict(
+        decode_window=not args.no_decode_window,
+        decode_tiers=args.decode_tiers,
+        decode_tier_lens=(
+            [int(x) for x in args.decode_tier_lens.split(",")]
+            if args.decode_tier_lens else None
+        ),
+        decode_tier_slots=(
+            [int(x) for x in args.decode_tier_slots.split(",")]
+            if args.decode_tier_slots else None
+        ),
+    )
     if args.model_path:
         cfg = TransformerConfig.from_hf(args.model_path)
         engine = GenEngine(
@@ -468,11 +505,12 @@ def main():
             max_seq_len=args.max_seq_len,
             tp=args.tp,
             ep=args.ep,
+            **tier_kw,
         )
     else:
         engine = GenEngine(tiny_config(), n_slots=args.n_slots,
                            max_seq_len=args.max_seq_len, tp=args.tp,
-                           ep=args.ep)
+                           ep=args.ep, **tier_kw)
     serve(
         engine,
         port=args.port or None,

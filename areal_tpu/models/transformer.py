@@ -688,32 +688,52 @@ def forward_prefill_cached(
 def forward_decode(
     params: Params,
     cfg: TransformerConfig,
-    tokens: jax.Array,  # [S] last generated token per slot
-    lengths: jax.Array,  # [S] current sequence length (cache fill) per slot
+    tokens: jax.Array,  # [B] last generated token per slot in the block
+    lengths: jax.Array,  # [B] current sequence length (cache fill) per slot
     cache: Dict[str, jax.Array],
-    rope_positions: Optional[jax.Array] = None,  # [S] logical rope position
+    rope_positions: Optional[jax.Array] = None,  # [B] logical rope position
+    key_window: Optional[int] = None,  # STATIC bucketed attended span
+    slot_base: int = 0,  # STATIC first cache row of the dispatched block
+    active: Optional[jax.Array] = None,  # bool [B]; False drops the KV write
 ):
-    """One decode step for every slot; returns (logits [S, V], new cache).
-    The new token's K/V is written at cache position `lengths[s]`.
+    """One decode step for a contiguous block of `B` slots starting at cache
+    row `slot_base`; returns (logits [B, V], new cache).  The new token's
+    K/V is written at cache position `lengths[s]`.
+
+    `key_window` bounds attention, masks, and the cache write to the first
+    K cache columns: decode FLOPs and HBM reads then track the occupied
+    span, not the configured `max_seq_len` ceiling (ISSUE 5 — the decode
+    analogue of `forward_prefill_cached`'s bucketed window).  K is STATIC
+    and must come from a bucket ladder; the caller guarantees
+    K >= max(lengths of active slots) + steps for the whole fused chunk.
+    `slot_base`/`B` carve a length-cohort tier out of the slot grid — one
+    dispatch per tier keeps a long outlier from inflating K for everyone.
+
+    `active` masks the cache write per slot (out-of-window scatter drop):
+    idle slots riding a tier dispatch would otherwise clamp their garbage
+    write into column K-1, which may sit INSIDE a freed slot's retained
+    prefix when K is windowed (full-width decode never had the hazard —
+    the M-1 clamp was always past any retained frontier).
 
     `rope_positions` separates the rotary position from the cache index:
     VLM slots compress an image's placeholder run into a small mrope extent,
     so post-image text continues at a logical position < cache length (for
     equal (t,h,w) text positions, sectioned mrope equals standard rope, so
     decode needs only the scalar)."""
-    S = tokens.shape[0]
+    B = tokens.shape[0]
     M = cache["k"].shape[2]
+    K = min(key_window, M) if key_window else M
     dtype = jnp.dtype(cfg.dtype)
     rp = lengths if rope_positions is None else rope_positions
-    positions = rp[:, None].astype(jnp.int32)  # [S, 1]
+    positions = rp[:, None].astype(jnp.int32)  # [B, 1]
     cos, sin = rope_cos_sin(positions, cfg.head_dim_, cfg.rope_theta)
     x = _embed(params, cfg, tokens[:, None], dtype, positions=positions)
     # attend to cache positions 0..lengths (inclusive: self just written)
-    key_pos = jnp.arange(M, dtype=jnp.int32)[None, :]
+    key_pos = jnp.arange(K, dtype=jnp.int32)[None, :]
     per_layer_window = (
         cfg.sliding_window is not None and cfg.layer_is_sliding is not None
     )
-    attn_mask = (key_pos <= lengths[:, None])[:, None, None, :]  # [S,1,1,M]
+    attn_mask = (key_pos <= lengths[:, None])[:, None, None, :]  # [B,1,1,K]
     mask_win = None
     if cfg.sliding_window is not None:
         # window over CACHE indices, not rope positions (they diverge on
@@ -725,7 +745,15 @@ def forward_decode(
             mask_win = win
         else:
             attn_mask = win
-    slots = jnp.arange(S)
+    slots = slot_base + jnp.arange(B)
+    # clamp: a slot past its cache end (freed host-side mid-chunk, still
+    # advancing in the fused decode scan) overwrites the window's last
+    # column with garbage instead of stalling the whole grid (VERDICT r3
+    # weak #3); inactive slots drop the write entirely (index M is
+    # out-of-bounds -> scatter mode="drop")
+    widx = jnp.minimum(lengths, K - 1)
+    if active is not None:
+        widx = jnp.where(active, widx, M)
 
     def layer(x, xs):
         lp, sliding, ck, cv = xs
@@ -737,18 +765,19 @@ def forward_decode(
         if cfg.pos_emb == "rope":
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-        # clamp: a slot past its cache end (freed host-side mid-chunk, still
-        # advancing in the fused decode scan) overwrites position M-1 with
-        # garbage instead of stalling the whole grid — the engine no longer
-        # caps the chunk to the fullest slot (VERDICT r3 weak #3)
-        widx = jnp.minimum(lengths, M - 1)
-        ck = ck.at[slots, widx].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[slots, widx].set(v[:, 0].astype(cv.dtype))
+        ck = ck.at[slots, widx].set(k[:, 0].astype(ck.dtype), mode="drop")
+        cv = cv.at[slots, widx].set(v[:, 0].astype(cv.dtype), mode="drop")
+        # read only the block's rows and the attended window [0, K): the
+        # cache keeps its full [S_total, M] shape, attention never touches
+        # rows outside the tier or columns past the window
+        ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
+        cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
         attn = attention(
-            q, ck.astype(dtype), cv.astype(dtype), m, cfg.attn_logit_softcap
+            q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
+            cfg.attn_logit_softcap,
         )
         delta = _proj(
-            cfg, lp["attn"], "wo", attn.reshape(S, 1, cfg.q_size), dtype,
+            cfg, lp["attn"], "wo", attn.reshape(B, 1, cfg.q_size), dtype,
             bias="bo",
         )
         if cfg.sandwich_norms:

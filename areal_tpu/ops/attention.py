@@ -48,6 +48,25 @@ except Exception:  # pragma: no cover
 
 MASK_VALUE = -2.3819763e38
 
+
+def _shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` became a top-level API only recently; older jaxlibs
+    (0.4.x) ship it as `jax.experimental.shard_map.shard_map` with the
+    replication check spelled `check_rep`.  One shim keeps both call sites
+    working across the installed range instead of failing with
+    AttributeError on the older runtime."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
 # Tests flip this to run the Pallas kernels in interpret mode on the CPU
 # mesh — the only way to exercise the sharded splash path without 8 chips.
 INTERPRET = False
@@ -275,7 +294,7 @@ def ring_attention(
         return out.astype(qb.dtype)
 
     qg = q.reshape(B, T, Hkv, group, hd)
-    out = jax.shard_map(
+    out = _shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -370,7 +389,7 @@ def _sharded_splash(
     qs = (q * float(1.0 / np.sqrt(hd))).transpose(0, 2, 1, 3).reshape(B, Hkv, group, T, hd)
     ks = k.transpose(0, 2, 1, 3)
     vs = v.transpose(0, 2, 1, 3)
-    out = jax.shard_map(
+    out = _shard_map(
         body,
         mesh=mesh,
         in_specs=(

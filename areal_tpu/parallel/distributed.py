@@ -63,6 +63,19 @@ def init_distributed(
         if process_id is not None
         else int(os.environ["AREAL_PROCESS_ID"])
     )
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        # XLA:CPU has no cross-process collectives of its own ("Multiprocess
+        # computations aren't implemented on the CPU backend"); the gloo
+        # TCP backend provides them.  Must be configured BEFORE the backend
+        # initializes — and only for explicit CPU runs (the multi-process
+        # CPU tests): TPU runs use ICI/DCN and must not see this.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older/newer jax without the knob
+            logger.warning(
+                "could not select gloo CPU collectives; multi-process CPU "
+                "collectives may be unavailable"
+            )
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -118,7 +131,12 @@ def broadcast_pytree(obj: Any, is_source: Optional[bool] = None) -> Any:
     if is_source:
         buf[:] = payload
     buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
-    return pickle.loads(bytes(np.asarray(buf)))
+    # broadcast_one_to_all implements the broadcast as a psum behind a
+    # source flag, which PROMOTES the dtype on some backends (uint8 ->
+    # float); the values stay exact (<= 255) but bytes() of the promoted
+    # buffer would reinterpret float words as pickle opcodes — cast back
+    # before decoding
+    return pickle.loads(np.asarray(buf).astype(np.uint8).tobytes())
 
 
 def make_global_batch(

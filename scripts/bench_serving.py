@@ -114,6 +114,11 @@ def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
                 "tokens_per_sec": round(delivered / dt, 1),
                 "wall_s": round(dt, 2),
                 "decode_calls": eng.stats["decode_calls"],
+                # attended span / ceiling (ISSUE 5 window accounting):
+                # decode reads this fraction of the configured cache width
+                "decode_attended_fraction": round(
+                    eng.decode_attended_fraction(), 4
+                ),
             }
             print(f"decode n_slots={n_slots}: {out[str(n_slots)]}",
                   file=sys.stderr, flush=True)
@@ -290,6 +295,76 @@ def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
     return out
 
 
+def bench_decode_ceiling_ab(cfg, params, n_slots=16, ceilings=(4096, 16384),
+                            prompt_len=64, gen_tokens=128, tiers=1,
+                            window=True):
+    """ISSUE 5 acceptance A/B: the SAME decode workload under different
+    `max_seq_len` ceilings.  Before the bucketed key window, decode
+    attention read the full ceiling width every step, so tokens/s degraded
+    as the ceiling grew even though the workload never used the headroom;
+    with tiered/windowed decode the large-ceiling number should land
+    within ~10% of the small-ceiling one.  Reports per-ceiling tokens/s,
+    `decode_attended_fraction`, and the large/small throughput ratio."""
+    from areal_tpu.gen.engine import GenRequest
+
+    out = {"n_slots": n_slots, "prompt_len": prompt_len,
+           "gen_tokens": gen_tokens, "decode_window": window,
+           "decode_tiers": tiers}
+    per = {}
+    for ceiling in ceilings:
+        rng = np.random.default_rng(7)  # identical workload per ceiling
+        try:
+            eng = _engine(cfg, params, n_slots, ceiling, kv_reuse=False,
+                          decode_window=window, decode_tiers=tiers)
+            warm = [
+                GenRequest(rid=f"w{i}",
+                           input_ids=rng.integers(0, cfg.vocab_size,
+                                                  prompt_len).tolist(),
+                           max_new_tokens=8, temperature=1.0)
+                for i in range(n_slots)
+            ]
+            eng.generate_blocking(warm)
+            _reset_stats(eng)
+            reqs = [
+                GenRequest(rid=f"m{i}",
+                           input_ids=rng.integers(0, cfg.vocab_size,
+                                                  prompt_len).tolist(),
+                           max_new_tokens=gen_tokens, temperature=1.0)
+                for i in range(n_slots)
+            ]
+            for r in reqs:
+                eng.submit(r)
+            eng.step()  # admission (prefill) outside the decode timing
+            t0 = time.perf_counter()
+            delivered = 0
+            while any(not r.stop_reason for r in reqs):
+                delivered += eng.step()
+            dt = time.perf_counter() - t0
+            per[str(ceiling)] = {
+                "tokens_per_sec": round(delivered / dt, 1),
+                "wall_s": round(dt, 2),
+                "decode_attended_fraction": round(
+                    eng.decode_attended_fraction(), 4
+                ),
+            }
+            print(f"ceiling_ab max_seq_len={ceiling}: {per[str(ceiling)]}",
+                  file=sys.stderr, flush=True)
+            del eng
+        except Exception as e:  # noqa: BLE001 — record and continue the A/B
+            per[str(ceiling)] = {"error": str(e)[:200]}
+            print(f"ceiling_ab max_seq_len={ceiling} failed: {str(e)[:120]}",
+                  file=sys.stderr, flush=True)
+    out["by_ceiling"] = per
+    lo, hi = str(min(ceilings)), str(max(ceilings))
+    if "tokens_per_sec" in per.get(lo, {}) and "tokens_per_sec" in per.get(hi, {}):
+        # >= 0.9 is the acceptance bar: the large ceiling costs <= 10%
+        out["large_over_small_tok_s"] = round(
+            per[hi]["tokens_per_sec"] / max(per[lo]["tokens_per_sec"], 1e-9),
+            3,
+        )
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--slots", default="8,32,64,128,256")
@@ -297,6 +372,17 @@ def main():
     p.add_argument("--skip-prefill", action="store_true")
     p.add_argument("--skip-multi-turn", action="store_true")
     p.add_argument("--skip-group", action="store_true")
+    p.add_argument("--skip-ceiling-ab", action="store_true")
+    # tiered-decode ceiling A/B knobs (ISSUE 5 acceptance: large ceiling
+    # within 10% of small on the same workload)
+    p.add_argument("--ab-slots", type=int, default=16)
+    p.add_argument("--ab-ceilings", default="4096,16384")
+    p.add_argument("--ab-prompt", type=int, default=64)
+    p.add_argument("--ab-gen", type=int, default=128)
+    p.add_argument("--ab-tiers", type=int, default=1)
+    p.add_argument("--no-decode-window", action="store_true",
+                   help="A/B with the window disabled (reproduces the "
+                        "pre-ISSUE-5 ceiling-bound decode)")
     # group fan-out regime knobs (GRPO-shaped grouped admission)
     p.add_argument("--group-size", type=int, default=8)
     p.add_argument("--group-prompt", type=int, default=256)
@@ -338,6 +424,13 @@ def main():
         result["grouped"] = bench_group_fanout(
             cfg, params, group_size=args.group_size,
             n_groups=args.n_groups, prompt_len=args.group_prompt,
+        )
+    if not args.skip_ceiling_ab:
+        result["decode_ceiling_ab"] = bench_decode_ceiling_ab(
+            cfg, params, n_slots=args.ab_slots,
+            ceilings=tuple(int(c) for c in args.ab_ceilings.split(",")),
+            prompt_len=args.ab_prompt, gen_tokens=args.ab_gen,
+            tiers=args.ab_tiers, window=not args.no_decode_window,
         )
     print(json.dumps(result))
 

@@ -24,6 +24,18 @@ def disciplined(self, prompts):
     return toks, total
 
 
+def sync_then_chain(self):
+    import jax.numpy as jnp
+
+    # uploads of standing state live in a dedicated sync (NOT a jit-call
+    # argument), and the steady-state loop chains device results instead
+    self._dev_lengths = jnp.asarray(self.lengths)
+    out, self._dev_lengths = self._decode_fn(self.params, self._dev_lengths)
+    # per-batch LOCALS as jit args are new data, not a re-upload
+    rows = np.zeros(4, np.int32)
+    return self._prefill_fn(self.params, jnp.asarray(rows), out)
+
+
 def host_only(batch):
     mask = np.asarray(batch["mask"])  # wire data, never device-resident
     return float(mask.mean())

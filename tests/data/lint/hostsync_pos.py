@@ -2,16 +2,21 @@
 
 Expected findings: host-sync (np.asarray/float on a jitted result),
 host-item (.item()), unbucketed-shape (len()-derived int into a jitted
-call).
+call), host-upload (jnp.asarray(self.<attr>) re-uploaded per dispatch).
 """
 # areal-lint: hot-path
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
 def decode_loop(self, prompts):
-    toks, cache = self._decode_fn(self.params, self.cache)
+    toks, cache = self._decode_fn(
+        self.params,
+        self.cache,
+        jnp.asarray(self.lengths),  # VIOLATION host-upload: standing state
+    )
     host = np.asarray(toks)  # VIOLATION host-sync: fence per loop pass
     first = float(toks)  # VIOLATION host-sync: scalar fence
     flag = cache.sum().item()  # VIOLATION host-item

@@ -479,3 +479,11 @@ def test_generate_batch_groups_share_prefix(live_server):
     # the abort-reservation TTL counter is exported (VERDICT r6 #10) and
     # stays zero on this storm-free path
     assert m["reservations_lapsed"] == 0
+    # tiered decode observability (ISSUE 5): attended-span fraction,
+    # per-cohort occupancy/layout, and the migration counter all ride
+    # /metrics so the fleet can see what decode actually pays
+    assert 0.0 < m["decode_attended_fraction"] <= 1.0
+    assert isinstance(m["tier_occupancy"], list)
+    assert m["tier_slots"] and sum(m["tier_slots"]) == engine.n_slots
+    assert m["tier_lens"][-1] == engine.max_seq_len
+    assert m["tier_migrations"] >= 0

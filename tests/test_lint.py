@@ -119,6 +119,7 @@ def test_hostsync_positive_fixture():
         "host-sync",
         "host-sync",
         "host-sync",
+        "host-upload",
         "unbucketed-shape",
         "unbucketed-shape",
     ]
