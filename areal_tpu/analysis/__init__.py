@@ -1,6 +1,6 @@
-"""areal-lint: project-specific static analysis (ISSUE 3).
+"""areal-lint: project-specific static analysis (ISSUE 3 + ISSUE 9).
 
-Four AST checkers tuned to this codebase's invariants, plus an opt-in
+Seven checkers tuned to this codebase's invariants, plus an opt-in
 runtime validator for the lock annotations:
 
 - C1 `unlocked-field`   (lock_discipline)  — guarded fields under locks
@@ -8,12 +8,21 @@ runtime validator for the lock annotations:
   recompile hazards
 - C3 `async-blocking`   (async_blocking)   — event-loop stalls
 - C4 `dead-module`      (dead_modules)     — unreachable package code
+- C5 `lock-order` family (lock_order)      — interprocedural deadlock /
+  blocking-under-lock / atomicity-split analysis over the call graph
+- C6 `off-ladder-static` (jit_signatures)  — jit static-arg ladder proof
+  + checked-in per-function signature budgets
+- C7 `slot-*` typestate  (typestate)       — slot/cache-row lifecycle
+
+C5–C7 share the interprocedural substrate in callgraph.py (class/lock
+index, call resolution, summary fixpoint).
 
 CLI: ``python scripts/lint.py --check`` (the tier-1 gate runs the same
 suite via tests/test_lint.py::test_repo_clean).  Catalog, annotation and
 suppression syntax: docs/lint.md.
 """
 
+from areal_tpu.analysis.callgraph import CallGraph, fixpoint
 from areal_tpu.analysis.core import (
     KNOWN_RULES,
     Finding,
@@ -23,6 +32,11 @@ from areal_tpu.analysis.core import (
     suppression_hygiene,
     unsuppressed,
 )
+from areal_tpu.analysis.jit_signatures import (
+    budget_drift,
+    compute_budgets,
+    render_budget_doc,
+)
 from areal_tpu.analysis.lockcheck import (
     LockDisciplineError,
     debug_locks_enabled,
@@ -31,12 +45,17 @@ from areal_tpu.analysis.lockcheck import (
 
 __all__ = [
     "KNOWN_RULES",
+    "CallGraph",
     "Finding",
     "SourceFile",
+    "fixpoint",
     "load_files",
     "run_suite",
     "suppression_hygiene",
     "unsuppressed",
+    "budget_drift",
+    "compute_budgets",
+    "render_budget_doc",
     "LockDisciplineError",
     "debug_locks_enabled",
     "lock_guarded",

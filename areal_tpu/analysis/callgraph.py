@@ -1,0 +1,274 @@
+"""areal-lint interprocedural core: module-level call graph + fixpoint.
+
+The C1–C4 checkers are per-file and lexical.  The v2 checkers (C5
+lock-order, C6 jit signature budgets, C7 slot typestate) need facts that
+cross function boundaries — "does this callee acquire a lock my caller
+already holds", "which fields does this helper write", "what values can
+this parameter carry" — so this module builds the shared substrate once
+per lint run:
+
+- an index of every class and function in the scanned tree, keyed
+  ``"<rel>::<Class>.<meth>"`` / ``"<rel>::<func>"``;
+- a per-class **lock registry** read from ``__init__`` assignments
+  (``self._lock = threading.Lock()`` → kind ``threading``;
+  ``asyncio.Lock()`` → ``asyncio``; ``RLock`` marks reentrancy);
+- **attribute type inference** good enough for this codebase's idiom:
+  ``def __init__(self, engine: GenEngine)`` + ``self.engine = engine``
+  and ``self.x = ClassName(...)`` give ``self.engine.step()`` a target;
+- call resolution for ``self.m()``, ``self.attr.m()`` and same-module
+  bare calls (anything else resolves to ``None`` — the checkers treat
+  unresolved calls conservatively per-rule);
+- a generic ``fixpoint`` worklist so each checker can propagate its own
+  summary lattice (lock sets, write sets, blocking witnesses) to
+  convergence without re-implementing the iteration.
+
+Deliberately NOT a points-to analysis: the repo's concurrency surface is
+a handful of long-lived singletons wired by constructor injection, which
+is exactly what this resolves.  Precision failures are soundly degraded:
+an unresolvable call contributes no facts, so checkers stay
+false-positive-free at the cost of missing exotic call shapes.
+"""
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from areal_tpu.analysis.core import SourceFile
+
+_LOCK_FACTORIES = {
+    "threading.Lock": ("threading", False),
+    "threading.RLock": ("threading", True),
+    "asyncio.Lock": ("asyncio", False),
+    "asyncio.Condition": ("asyncio", False),
+    "asyncio.Semaphore": ("asyncio", False),
+    "threading.Condition": ("threading", False),
+    "threading.Semaphore": ("threading", False),
+}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> "a.b.c"; None for anything not a pure dotted chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class LockInfo:
+    name: str  # attribute name, e.g. "_lock"
+    kind: str  # "threading" | "asyncio" | "unknown"
+    reentrant: bool = False
+
+
+@dataclass
+class ClassInfo:
+    key: str  # "<rel>::<name>"
+    name: str
+    rel: str
+    node: ast.ClassDef
+    sf: SourceFile
+    locks: Dict[str, LockInfo] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> bare class name
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> func key
+
+
+@dataclass
+class FuncInfo:
+    key: str
+    name: str
+    rel: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    sf: SourceFile
+    cls_key: Optional[str] = None  # owning ClassInfo key, if a method
+
+
+class CallGraph:
+    """Class/function index + call resolution over one scanned tree."""
+
+    def __init__(self, files: Dict[str, SourceFile]):
+        self.files = files
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.classes_by_name: Dict[str, List[str]] = {}
+        self._module_funcs: Dict[Tuple[str, str], str] = {}
+        for rel, sf in files.items():
+            if sf.tree is None:
+                continue
+            self._index_module(rel, sf)
+        for ci in self.classes.values():
+            self._infer_class_facts(ci)
+        # callee edges, resolved once: key -> [(ast.Call, callee key|None)]
+        self.calls: Dict[str, List[Tuple[ast.Call, Optional[str]]]] = {}
+        for fi in self.functions.values():
+            self.calls[fi.key] = [
+                (call, self.resolve_call(fi, call))
+                for call in self._own_calls(fi.node)
+            ]
+
+    # ------------------------------ indexing ---------------------------
+
+    def _index_module(self, rel: str, sf: SourceFile) -> None:
+        for stmt in sf.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{rel}::{stmt.name}"
+                self.functions[key] = FuncInfo(key, stmt.name, rel, stmt, sf)
+                self._module_funcs[(rel, stmt.name)] = key
+            elif isinstance(stmt, ast.ClassDef):
+                ckey = f"{rel}::{stmt.name}"
+                ci = ClassInfo(ckey, stmt.name, rel, stmt, sf)
+                self.classes[ckey] = ci
+                self.classes_by_name.setdefault(stmt.name, []).append(ckey)
+                for meth in stmt.body:
+                    if isinstance(
+                        meth, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        mkey = f"{rel}::{stmt.name}.{meth.name}"
+                        self.functions[mkey] = FuncInfo(
+                            mkey, meth.name, rel, meth, sf, cls_key=ckey
+                        )
+                        ci.methods[meth.name] = mkey
+
+    def _infer_class_facts(self, ci: ClassInfo) -> None:
+        init_key = ci.methods.get("__init__")
+        if init_key is None:
+            return
+        init = self.functions[init_key].node
+        param_types: Dict[str, str] = {}
+        args = init.args
+        for a in list(args.posonlyargs) + list(args.args) + list(
+            args.kwonlyargs
+        ):
+            ann = a.annotation
+            if isinstance(ann, ast.Name):
+                param_types[a.arg] = ann.id
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                param_types[a.arg] = ann.value.split(".")[-1].strip("'\" ")
+            elif (
+                isinstance(ann, ast.Subscript)
+                and dotted_name(ann.value) in ("Optional", "typing.Optional")
+                and isinstance(ann.slice, ast.Name)
+            ):
+                param_types[a.arg] = ann.slice.id
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                val = node.value
+                if isinstance(val, ast.Call):
+                    d = dotted_name(val.func)
+                    if d in _LOCK_FACTORIES:
+                        kind, re = _LOCK_FACTORIES[d]
+                        ci.locks[tgt.attr] = LockInfo(tgt.attr, kind, re)
+                    elif d in ("Lock", "RLock"):
+                        ci.locks[tgt.attr] = LockInfo(
+                            tgt.attr, "unknown", d == "RLock"
+                        )
+                    elif d in self.classes_by_name:
+                        ci.attr_types[tgt.attr] = d
+                    elif "lock" in tgt.attr.lower():
+                        ci.locks.setdefault(
+                            tgt.attr, LockInfo(tgt.attr, "unknown", False)
+                        )
+                elif isinstance(val, ast.Name) and val.id in param_types:
+                    ci.attr_types[tgt.attr] = param_types[val.id]
+
+    # ----------------------------- resolution --------------------------
+
+    def _class_by_bare_name(self, name: str) -> Optional[ClassInfo]:
+        keys = self.classes_by_name.get(name, [])
+        if len(keys) == 1:  # ambiguous bare names resolve to nothing
+            return self.classes[keys[0]]
+        return None
+
+    def resolve_call(
+        self, caller: FuncInfo, call: ast.Call
+    ) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):  # bare same-module call
+            return self._module_funcs.get((caller.rel, f.id))
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if caller.cls_key is not None:
+                return self.classes[caller.cls_key].methods.get(f.attr)
+            return None
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and caller.cls_key is not None
+        ):
+            # self.<attr>.<meth>() through the inferred attribute type
+            owner = self.classes[caller.cls_key]
+            tname = owner.attr_types.get(recv.attr)
+            if tname:
+                target = self._class_by_bare_name(tname)
+                if target is not None:
+                    return target.methods.get(f.attr)
+        return None
+
+    @staticmethod
+    def _own_calls(fn: ast.AST) -> List[ast.Call]:
+        """Call nodes in `fn`'s own body, not descending into nested
+        defs/lambdas (those run at an unknown later time — each nested def
+        is its own analysis context, or no context at all)."""
+        out: List[ast.Call] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def lock_of(
+        self, caller: FuncInfo, attr: str
+    ) -> Optional[Tuple[str, LockInfo]]:
+        """`with self.<attr>:` in `caller` -> (owning class key, LockInfo)
+        when <attr> is a registered lock of the caller's class."""
+        if caller.cls_key is None:
+            return None
+        li = self.classes[caller.cls_key].locks.get(attr)
+        if li is None:
+            return None
+        return caller.cls_key, li
+
+
+def fixpoint(
+    init: Dict[str, Set],
+    edges: Dict[str, Iterable[str]],
+) -> Dict[str, Set]:
+    """Transitive set union over the call graph: out[f] = init[f] ∪
+    ⋃ out[callee].  `edges[f]` lists f's callees; unknown keys contribute
+    nothing.  Terminates because summaries only grow within finite sets."""
+    out: Dict[str, Set] = {k: set(v) for k, v in init.items()}
+    callers: Dict[str, List[str]] = {}
+    for f, cs in edges.items():
+        for c in cs:
+            callers.setdefault(c, []).append(f)
+    work = list(init)
+    while work:
+        f = work.pop()
+        merged = set(out.get(f, ()))
+        for c in edges.get(f, ()):
+            merged |= out.get(c, set())
+        if merged != out.get(f, set()):
+            out[f] = merged
+            work.extend(callers.get(f, ()))
+    return out

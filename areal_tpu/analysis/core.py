@@ -46,6 +46,15 @@ KNOWN_RULES = frozenset(
         "async-blocking",
         "dead-module",
         "bad-suppression",
+        # v2 interprocedural checkers (ISSUE 9)
+        "lock-order",
+        "blocking-under-lock",
+        "atomicity-split",
+        "off-ladder-static",
+        "signature-budget-stale",
+        "slot-double-free",
+        "slot-lifecycle",
+        "retained-unversioned",
     }
 )
 
@@ -235,11 +244,14 @@ def load_files(
 
 
 def run_suite(root: str, package: str = "areal_tpu") -> List[Finding]:
-    """Run all four checkers plus suppression hygiene over the tree."""
+    """Run all checkers (C1–C7) plus suppression hygiene over the tree."""
     from areal_tpu.analysis.async_blocking import check_async_blocking
     from areal_tpu.analysis.dead_modules import check_dead_modules
     from areal_tpu.analysis.host_sync import check_host_sync
+    from areal_tpu.analysis.jit_signatures import check_jit_signatures
     from areal_tpu.analysis.lock_discipline import check_lock_discipline
+    from areal_tpu.analysis.lock_order import check_lock_order
+    from areal_tpu.analysis.typestate import check_typestate
 
     files = load_files(root)
     findings: List[Finding] = []
@@ -251,6 +263,10 @@ def run_suite(root: str, package: str = "areal_tpu") -> List[Finding]:
         findings.extend(check_async_blocking(sf))
         findings.extend(suppression_hygiene(sf))
     findings.extend(check_dead_modules(root, files, package=package))
+    # set-level interprocedural checkers (shared call graph per checker)
+    findings.extend(check_lock_order(files))
+    findings.extend(check_typestate(files))
+    findings.extend(check_jit_signatures(files, root))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

@@ -1,0 +1,607 @@
+"""C5 — interprocedural lock-order / deadlock discipline.
+
+Three rules over the callgraph.py substrate, all aimed at the failure
+class PR 3's per-file C1 cannot see — a lock taken *here* interacting
+with something that happens *there*:
+
+- ``lock-order``: a cyclic acquisition order between registered locks.
+  Nesting facts are collected lexically (``with self._a:`` containing
+  ``with self._b:``) AND through calls (holding ``_a`` while calling a
+  function whose transitive acquisition set contains ``_b``), then
+  combined with the **declared** order edges (``# lock-order: _a -> _b``
+  comments in a class body — the sanctioned nesting).  Any discovered
+  edge participating in a cycle of the combined digraph is reported at
+  its acquisition/call site.  Re-acquiring a held non-reentrant lock
+  (lexically or via a callee) is the degenerate one-lock cycle and is
+  reported under the same rule — for ``asyncio.Lock`` lexical nesting is
+  a guaranteed same-task deadlock.
+- ``blocking-under-lock``: an ``await``, a known blocking call (the C3
+  tables: ``time.sleep``, ``requests.*``, subprocess waits, file I/O), or
+  a user-callback invocation (``*.finish(...)`` — it runs arbitrary
+  ``on_done`` hooks that may re-enter the engine) while a
+  ``threading``-kind lock is held, directly or through any callee chain.
+  Holding an ``asyncio.Lock`` across ``await`` is legal and not flagged.
+- ``atomicity-split``: within one function, a ``_GUARDED_FIELDS`` field
+  read in one critical section and then **blindly overwritten** in a
+  later critical section of the same lock — the classic check-then-act
+  race (ADVICE r5's ``_holdback`` bug shape).  A write whose value
+  expression itself re-reads the field (merge/read-modify-write, e.g.
+  ``self._holdback = leftover + self._holdback``) re-validates under the
+  second lock hold and is exempt, as are ``+=``-style AugAssigns.
+
+Lock identity is (owning class, attribute): ``Router._lock`` and
+``GenEngine._lock`` are distinct nodes, so cross-class edges only arise
+through actual resolved calls.
+"""
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from areal_tpu.analysis.async_blocking import (
+    _BLOCKING_EXACT,
+    _BLOCKING_METHODS,
+    _BLOCKING_PREFIXES,
+)
+from areal_tpu.analysis.callgraph import CallGraph, FuncInfo, dotted_name
+from areal_tpu.analysis.core import Finding, SourceFile, apply_suppression
+from areal_tpu.analysis.lock_discipline import _guarded_fields, _holds_of
+
+RULE_ORDER = "lock-order"
+RULE_BLOCK = "blocking-under-lock"
+RULE_ATOMIC = "atomicity-split"
+
+# invoking these methods runs user-supplied callbacks (GenRequest.finish
+# fires on_done hooks and wakes waiters) — arbitrary re-entrant code
+_CALLBACK_METHODS = {"finish"}
+
+_ORDER_DECL_RE = re.compile(
+    r"#\s*lock-order:\s*([A-Za-z_]\w*)\s*->\s*([A-Za-z_]\w*)"
+)
+
+LockId = Tuple[str, str]  # (owning class key, lock attribute)
+
+
+def _fmt(lock: LockId) -> str:
+    cls = lock[0].split("::")[-1]
+    return f"{cls}.{lock[1]}"
+
+
+@dataclass
+class _Event:
+    kind: str  # "acquire" | "call" | "await" | "blocking"
+    line: int
+    held: FrozenSet[LockId]
+    lock: Optional[LockId] = None  # acquire
+    callee: Optional[str] = None  # call
+    detail: str = ""  # blocking description / call text
+
+
+@dataclass
+class _Summary:
+    fi: FuncInfo
+    entry_held: Set[LockId] = field(default_factory=set)
+    acquires: Set[LockId] = field(default_factory=set)
+    events: List[_Event] = field(default_factory=list)
+    blocks: Optional[Tuple[int, str]] = None  # first local witness
+
+
+class _Walker(ast.NodeVisitor):
+    """Lexical walk of one function body tracking the held lock set.
+    Nested defs/lambdas are skipped: they run at an unknown time, so an
+    enclosing `with` guarantees nothing about their execution context."""
+
+    def __init__(self, graph: CallGraph, summary: _Summary):
+        self.graph = graph
+        self.s = summary
+        self.held: Set[LockId] = set(summary.entry_held)
+
+    # -- nested contexts are opaque to C5 -------------------------------
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    # -- with blocks ----------------------------------------------------
+    def visit_With(self, node: ast.With):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._visit_with(node, is_async=True)
+
+    def _visit_with(self, node, is_async: bool):
+        added: List[LockId] = []
+        for item in node.items:
+            e = item.context_expr
+            self.visit(e)
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+            ):
+                resolved = self.graph.lock_of(self.s.fi, e.attr)
+                if resolved is None:
+                    continue
+                ckey, li = resolved
+                lock: LockId = (ckey, li.name)
+                self.s.events.append(
+                    _Event(
+                        "acquire",
+                        e.lineno,
+                        frozenset(self.held),
+                        lock=lock,
+                        detail=li.kind,
+                    )
+                )
+                self.s.acquires.add(lock)
+                if lock not in self.held:
+                    self.held.add(lock)
+                    added.append(lock)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lock in added:
+            self.held.discard(lock)
+
+    # -- blocking primitives --------------------------------------------
+    def visit_Await(self, node: ast.Await):
+        self.s.events.append(
+            _Event("await", node.lineno, frozenset(self.held))
+        )
+        if self.s.blocks is None:
+            self.s.blocks = (node.lineno, "await")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        d = dotted_name(node.func)
+        blocking: Optional[str] = None
+        if d is not None:
+            if d in _BLOCKING_EXACT:
+                blocking = f"{d}()"
+            elif any(d.startswith(p) for p in _BLOCKING_PREFIXES):
+                blocking = f"{d}()"
+        if (
+            blocking is None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_METHODS
+        ):
+            blocking = f".{node.func.attr}()"
+        if (
+            blocking is None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CALLBACK_METHODS
+        ):
+            blocking = (
+                f".{node.func.attr}() (user callback / waiter wakeup)"
+            )
+        if blocking is not None:
+            self.s.events.append(
+                _Event(
+                    "blocking",
+                    node.lineno,
+                    frozenset(self.held),
+                    detail=blocking,
+                )
+            )
+            if self.s.blocks is None:
+                self.s.blocks = (node.lineno, blocking)
+        callee = None
+        for call, key in self.graph.calls.get(self.s.fi.key, ()):
+            if call is node:
+                callee = key
+                break
+        if callee is not None:
+            self.s.events.append(
+                _Event(
+                    "call",
+                    node.lineno,
+                    frozenset(self.held),
+                    callee=callee,
+                    detail=d or "",
+                )
+            )
+        self.generic_visit(node)
+
+
+def _declared_edges(
+    graph: CallGraph,
+) -> Tuple[Set[Tuple[LockId, LockId]], Dict[Tuple[LockId, LockId], int]]:
+    """`# lock-order: _a -> _b` comments inside a class body declare the
+    sanctioned nesting for that class's locks."""
+    edges: Set[Tuple[LockId, LockId]] = set()
+    lines: Dict[Tuple[LockId, LockId], int] = {}
+    for ci in graph.classes.values():
+        end = max(
+            (getattr(n, "end_lineno", ci.node.lineno) or ci.node.lineno)
+            for n in ast.walk(ci.node)
+        )
+        for ln in range(ci.node.lineno, end + 1):
+            m = _ORDER_DECL_RE.search(ci.sf.comments.get(ln, ""))
+            if not m:
+                continue
+            a, b = m.group(1), m.group(2)
+            if a in ci.locks and b in ci.locks:
+                edge = ((ci.key, a), (ci.key, b))
+                edges.add(edge)
+                lines[edge] = ln
+    return edges, lines
+
+
+def _cycle_nodes(adj: Dict[LockId, Set[LockId]]) -> Set[LockId]:
+    """Nodes on any directed cycle (Tarjan SCCs of size > 1, plus
+    self-loops)."""
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    onstack: Set[LockId] = set()
+    stack: List[LockId] = []
+    out: Set[LockId] = set()
+    counter = [0]
+
+    def strongconnect(v: LockId):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in adj.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            if len(scc) > 1 or v in adj.get(v, ()):
+                out.update(scc)
+
+    nodes = set(adj)
+    for tos in adj.values():
+        nodes |= tos
+    for v in nodes:
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def check_lock_order(files: Dict[str, SourceFile]) -> List[Finding]:
+    graph = CallGraph(files)
+    findings: List[Finding] = []
+
+    # ---- per-function local summaries ---------------------------------
+    summaries: Dict[str, _Summary] = {}
+    for key, fi in graph.functions.items():
+        if fi.name == "__init__":
+            continue
+        s = _Summary(fi)
+        if fi.cls_key is not None:
+            ci = graph.classes[fi.cls_key]
+            for lock_name in _holds_of(fi.sf, fi.node):
+                if lock_name in ci.locks:
+                    s.entry_held.add((fi.cls_key, lock_name))
+        w = _Walker(graph, s)
+        for stmt in fi.node.body:
+            w.visit(stmt)
+        summaries[key] = s
+
+    # ---- fixpoint: transitive acquires + blocking witnesses -----------
+    edges = {
+        key: [
+            e.callee
+            for e in s.events
+            if e.kind == "call" and e.callee in summaries
+        ]
+        for key, s in summaries.items()
+    }
+    from areal_tpu.analysis.callgraph import fixpoint
+
+    trans_acq = fixpoint(
+        {key: set(s.acquires) for key, s in summaries.items()}, edges
+    )
+    trans_block = fixpoint(
+        {
+            key: ({s.blocks[1]} if s.blocks is not None else set())
+            for key, s in summaries.items()
+        },
+        edges,
+    )
+
+    # ---- walk events: re-entry, await/blocking-under-lock, edges ------
+    lock_info = {
+        (ckey, name): li
+        for ckey, ci in graph.classes.items()
+        for name, li in ci.locks.items()
+    }
+
+    def thread_held(held: FrozenSet[LockId]) -> List[LockId]:
+        # unknown-kind locks are NOT treated as threading: flagging them
+        # would fire on asyncio locks behind aliased imports
+        return [l for l in held if lock_info[l].kind == "threading"]
+
+    order_edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+    for key, s in summaries.items():
+        sf = s.fi.sf
+        for e in s.events:
+            if e.kind == "acquire":
+                assert e.lock is not None
+                li = lock_info[e.lock]
+                if e.lock in e.held and not li.reentrant and li.kind in (
+                    "threading",
+                    "asyncio",
+                ):
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                RULE_ORDER,
+                                sf.rel,
+                                e.line,
+                                f"{s.fi.name} re-acquires non-reentrant "
+                                f"self.{e.lock[1]} already held on this "
+                                f"path — guaranteed self-deadlock",
+                            ),
+                        )
+                    )
+                for h in e.held:
+                    if h != e.lock:
+                        order_edges.setdefault(
+                            (h, e.lock), (sf.rel, e.line)
+                        )
+            elif e.kind == "await":
+                for h in thread_held(e.held):
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                RULE_BLOCK,
+                                sf.rel,
+                                e.line,
+                                f"await while holding threading lock "
+                                f"{_fmt(h)} — stalls every other thread "
+                                f"contending for it",
+                            ),
+                        )
+                    )
+            elif e.kind == "blocking":
+                for h in thread_held(e.held):
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                RULE_BLOCK,
+                                sf.rel,
+                                e.line,
+                                f"{e.detail} called while holding "
+                                f"{_fmt(h)} — move it outside the "
+                                f"critical section (collect-then-call)",
+                            ),
+                        )
+                    )
+            elif e.kind == "call" and e.callee in summaries:
+                callee_acq = trans_acq.get(e.callee, set())
+                for h in e.held:
+                    li = lock_info[h]
+                    if h in callee_acq and not li.reentrant:
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    RULE_ORDER,
+                                    sf.rel,
+                                    e.line,
+                                    f"{s.fi.name} holds {_fmt(h)} and "
+                                    f"calls {e.callee.split('::')[-1]} "
+                                    f"which (transitively) re-acquires "
+                                    f"it — self-deadlock on a "
+                                    f"non-reentrant lock",
+                                ),
+                            )
+                        )
+                    for b in callee_acq:
+                        if b != h:
+                            order_edges.setdefault(
+                                (h, b), (sf.rel, e.line)
+                            )
+                if thread_held(e.held) and trans_block.get(e.callee):
+                    witness = sorted(trans_block[e.callee])[0]
+                    for h in thread_held(e.held):
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    RULE_BLOCK,
+                                    sf.rel,
+                                    e.line,
+                                    f"call to "
+                                    f"{e.callee.split('::')[-1]} may "
+                                    f"block ({witness}) while holding "
+                                    f"{_fmt(h)}",
+                                ),
+                            )
+                        )
+
+    # ---- cycle detection over discovered + declared edges -------------
+    declared, _decl_lines = _declared_edges(graph)
+    adj: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in set(order_edges) | declared:
+        adj.setdefault(a, set()).add(b)
+    cyclic = _cycle_nodes(adj)
+    for (a, b), (rel, line) in sorted(order_edges.items(), key=lambda kv: kv[1]):
+        if (a, b) in declared:
+            continue  # sanctioned nesting
+        if a in cyclic and b in cyclic and b in adj and a in _reachable(
+            adj, b
+        ):
+            sf = files[rel]
+            findings.append(
+                apply_suppression(
+                    sf,
+                    Finding(
+                        RULE_ORDER,
+                        rel,
+                        line,
+                        f"acquiring {_fmt(b)} while holding {_fmt(a)} "
+                        f"closes a lock-order cycle (declare the "
+                        f"sanctioned order with `# lock-order: a -> b` "
+                        f"or invert the nesting)",
+                    ),
+                )
+            )
+
+    # ---- atomicity splits (intraprocedural, per guarded class) --------
+    for ci in graph.classes.values():
+        scratch: List[Finding] = []  # guard-syntax dupes belong to C1
+        guarded = _guarded_fields(ci.sf, ci.node, scratch)
+        if not guarded:
+            continue
+        for meth in ci.node.body:
+            if (
+                not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or meth.name == "__init__"
+            ):
+                continue
+            findings.extend(_atomicity_splits(ci.sf, ci.name, meth, guarded))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _reachable(adj: Dict[LockId, Set[LockId]], src: LockId) -> Set[LockId]:
+    seen: Set[LockId] = set()
+    work = [src]
+    while work:
+        v = work.pop()
+        for w in adj.get(v, ()):
+            if w not in seen:
+                seen.add(w)
+                work.append(w)
+    return seen
+
+
+def _attr_loads(node: ast.AST, fld: str) -> bool:
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == fld
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+        ):
+            return True
+    return False
+
+
+def _field_reads_writes(
+    with_node: ast.AST, fld: str
+) -> Tuple[List[int], List[Tuple[int, bool]]]:
+    """(read lines, [(write line, is_blind)]) for self.<fld> inside one
+    critical section.  A write is *blind* when its value expression never
+    re-reads the field (and it is not an AugAssign).  Constant writes
+    (``self._dirty = True``, ``self._cache = None``) are NOT blind: they
+    are deliberate resets/invalidations whose meaning cannot depend on
+    what happened between the holds — the lost-update hazard this rule
+    targets needs a computed value."""
+    reads: List[int] = []
+    writes: List[Tuple[int, bool]] = []
+    for n in ast.walk(with_node):
+        if isinstance(n, ast.Assign):
+            hit = False
+            for tgt in n.targets:
+                base = tgt
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and base.attr == fld
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    hit = True
+            if hit:
+                blind = not isinstance(
+                    n.value, ast.Constant
+                ) and not _attr_loads(n.value, fld)
+                writes.append((n.lineno, blind))
+                continue
+        if isinstance(n, ast.AugAssign):
+            base = n.target
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (
+                isinstance(base, ast.Attribute)
+                and base.attr == fld
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                writes.append((n.lineno, False))  # RMW: never blind
+                continue
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == fld
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+            and isinstance(n.ctx, ast.Load)
+        ):
+            reads.append(n.lineno)
+    return reads, writes
+
+
+def _atomicity_splits(
+    sf: SourceFile,
+    cls_name: str,
+    meth: ast.AST,
+    guarded: Dict[str, str],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    # critical sections of this method, in source order, keyed by lock
+    sections: List[Tuple[str, ast.AST]] = []
+    for n in ast.walk(meth):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                e = item.context_expr
+                if (
+                    isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"
+                ):
+                    sections.append((e.attr, n))
+    sections.sort(key=lambda p: p[1].lineno)
+    for fld, lock in guarded.items():
+        cs = [(ln, node) for (ln, node) in sections if ln == lock]
+        for i, (_, early) in enumerate(cs):
+            reads, _ = _field_reads_writes(early, fld)
+            if not reads:
+                continue
+            for _, late in cs[i + 1 :]:
+                if late is early:
+                    continue
+                _, writes = _field_reads_writes(late, fld)
+                for line, blind in writes:
+                    if blind:
+                        findings.append(
+                            apply_suppression(
+                                sf,
+                                Finding(
+                                    RULE_ATOMIC,
+                                    sf.rel,
+                                    line,
+                                    f"{cls_name}.{fld} read under "
+                                    f"self.{lock} at line {reads[0]} "
+                                    f"but blindly overwritten in a "
+                                    f"LATER critical section — the "
+                                    f"state may have changed between "
+                                    f"the two holds (merge with the "
+                                    f"current value or fuse the "
+                                    f"sections)",
+                                ),
+                            )
+                        )
+            break  # only the first reading section anchors the split
+    return findings

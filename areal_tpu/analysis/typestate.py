@@ -1,0 +1,474 @@
+"""C7 — slot / cache-row lifecycle typestate.
+
+The engine's slot machine is a typestate automaton the ROADMAP-item-1
+radix/paged-KV refactor must preserve:
+
+    free -> reserved -> prefilled -> decoding -> retained/free
+
+with cache-row ownership riding along (``kv_version`` stamps rows against
+stale reuse after a weight publish; a migration's source row frees as a
+*retained prefix*).  The automaton is declared on the class:
+
+    class GenEngine:
+        _SLOT_TYPESTATE = {
+            "owner": "slot_req",          # slot s is owned iff owner[s] is not None
+            "acquire_writes": [...],      # per-slot arrays an acquire MUST settle
+            "release_writes": [...],      # the only arrays writable after release
+            "version_field": "kv_version",
+            "retained_field": "retained_len",
+        }
+
+Rules (anchored on every ``self.<owner>[idx] = ...`` transition):
+
+- ``slot-double-free``: the same block frees ``owner[idx]`` twice with no
+  intervening re-acquire — the second free clobbers a slot that may have
+  been re-admitted concurrently.
+- ``slot-lifecycle``: an *acquire* (``owner[idx] = <req>``) that does not
+  settle every ``acquire_writes`` array for the same index in the same
+  block (a reservation/bookkeeping leak: the slot decodes with a stale
+  temperature, kv_version, or an un-cleared ``_reserved_until``); a
+  *release* (``owner[idx] = None``) that does not settle
+  ``retained_field``; or a write to a non-release array for an index the
+  block already freed (use-after-free of the row's bookkeeping).
+- ``retained-unversioned``: a method that acquires slots AND reads
+  ``retained_field`` (i.e. makes reuse decisions over retained rows) must
+  also read ``version_field`` — reusing a retained prefix without
+  consulting its version resurrects pre-publish K/V.
+
+Co-writes may be delegated: a helper called in the same block satisfies a
+required write when its **transitive** field-write summary (fixpoint over
+the call graph) covers the field — the interprocedural part, so the
+checker keeps up when the refactor extracts ``_activate_slot`` helpers.
+
+The ``for arr in (self.a, self.b, ...): arr[dst] = arr[s]`` idiom
+(migration state copy) counts as writing every tuple element.
+"""
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from areal_tpu.analysis.callgraph import CallGraph, fixpoint
+from areal_tpu.analysis.core import Finding, SourceFile, apply_suppression
+
+RULE_DOUBLE_FREE = "slot-double-free"
+RULE_LIFECYCLE = "slot-lifecycle"
+RULE_UNVERSIONED = "retained-unversioned"
+
+
+@dataclass
+class TypestateSpec:
+    owner: str
+    acquire_writes: List[str]
+    release_writes: List[str]
+    version_field: str
+    retained_field: str
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _parse_spec(
+    sf: SourceFile, cls: ast.ClassDef, findings: List[Finding]
+) -> Optional[TypestateSpec]:
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "_SLOT_TYPESTATE":
+                val = _literal(stmt.value)
+                if (
+                    not isinstance(val, dict)
+                    or not isinstance(val.get("owner"), str)
+                    or not isinstance(val.get("acquire_writes"), list)
+                ):
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                "guard-syntax",
+                                sf.rel,
+                                stmt.lineno,
+                                "_SLOT_TYPESTATE must be a literal dict "
+                                "with 'owner' and 'acquire_writes' (see "
+                                "docs/lint.md)",
+                            ),
+                        )
+                    )
+                    return None
+                return TypestateSpec(
+                    owner=val["owner"],
+                    acquire_writes=list(val["acquire_writes"]),
+                    release_writes=list(val.get("release_writes", [])),
+                    version_field=val.get("version_field", "kv_version"),
+                    retained_field=val.get("retained_field", "retained_len"),
+                )
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _subscript_write(stmt: ast.Assign) -> List[Tuple[str, str, ast.AST]]:
+    """[(field, index source text, value)] for `self.<field>[idx] = v`."""
+    out = []
+    for tgt in stmt.targets:
+        if isinstance(tgt, ast.Subscript):
+            fld = _self_attr(tgt.value)
+            if fld is not None:
+                out.append((fld, ast.unparse(tgt.slice), stmt.value))
+    return out
+
+
+def _function_write_sets(graph: CallGraph) -> Dict[str, Set[str]]:
+    """key -> self-attribute names the function (transitively) writes."""
+    local: Dict[str, Set[str]] = {}
+    for key, fi in graph.functions.items():
+        writes: Set[str] = set()
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    base = tgt
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    fld = _self_attr(base)
+                    if fld is not None:
+                        writes.add(fld)
+            elif isinstance(n, ast.AugAssign):
+                base = n.target
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                fld = _self_attr(base)
+                if fld is not None:
+                    writes.add(fld)
+            elif isinstance(n, ast.For) and isinstance(
+                n.iter, (ast.Tuple, ast.List)
+            ):
+                # for arr in (self.a, self.b): arr[i] = ... writes a and b
+                if any(
+                    isinstance(b, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        for t in b.targets
+                    )
+                    for b in ast.walk(n)
+                ):
+                    for el in n.iter.elts:
+                        fld = _self_attr(el)
+                        if fld is not None:
+                            writes.add(fld)
+        local[key] = writes
+    edges = {
+        key: [c for _, c in graph.calls.get(key, ()) if c is not None]
+        for key in graph.functions
+    }
+    return fixpoint(local, edges)
+
+
+@dataclass
+class _BlockWrite:
+    line: int
+    field: str
+    index: str
+    is_none: bool  # owner write of None (release)
+
+
+def _innermost_transitions(
+    meth: ast.AST, spec: TypestateSpec
+) -> Dict[int, Tuple[List[ast.stmt], List[_BlockWrite]]]:
+    """Owner transitions grouped by their INNERMOST enclosing statement
+    list (keyed by id(block)).  Each transition is analyzed exactly once,
+    against the tightest scope that contains it — the block where its
+    required co-writes live in every in-tree transition site."""
+    out: Dict[int, Tuple[List[ast.stmt], List[_BlockWrite]]] = {}
+
+    def visit(stmt: ast.stmt, block: List[ast.stmt]) -> None:
+        if isinstance(stmt, ast.Assign):
+            for fld, idx, val in _subscript_write(stmt):
+                if fld == spec.owner:
+                    tw = _BlockWrite(
+                        stmt.lineno,
+                        fld,
+                        idx,
+                        isinstance(val, ast.Constant) and val.value is None,
+                    )
+                    out.setdefault(id(block), (block, []))[1].append(tw)
+        for fname in ("body", "orelse", "finalbody"):
+            child = getattr(stmt, fname, None)
+            if isinstance(child, list):
+                for s in child:
+                    visit(s, child)
+        for h in getattr(stmt, "handlers", []) or []:
+            for s in h.body:
+                visit(s, h.body)
+
+    for s in meth.body:
+        visit(s, meth.body)
+    return out
+
+
+def _block_facts(
+    block: List[ast.stmt], spec: TypestateSpec
+) -> Tuple[Set[Tuple[str, str]], List[Tuple[int, str]]]:
+    """((field, idx) writes available as co-writes, helper calls
+    (line, attr name)) — searched recursively through the whole block, so
+    co-writes inside the same `for`/`if` count."""
+    cowrites: Set[Tuple[str, str]] = set()
+    helper_calls: List[Tuple[int, str]] = []
+    for stmt in block:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Assign):
+                for fld, idx, val in _subscript_write(n):
+                    if fld != spec.owner:
+                        cowrites.add((fld, idx))
+                # for arr in (self.a, ...): arr[idx] = ... expansion
+            elif isinstance(n, ast.For) and isinstance(
+                n.iter, (ast.Tuple, ast.List)
+            ):
+                loop_var = (
+                    n.target.id if isinstance(n.target, ast.Name) else None
+                )
+                if loop_var is None:
+                    continue
+                idxs = [
+                    ast.unparse(t.slice)
+                    for b in ast.walk(n)
+                    if isinstance(b, ast.Assign)
+                    for t in b.targets
+                    if isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == loop_var
+                ]
+                for el in n.iter.elts:
+                    fld = _self_attr(el)
+                    if fld is not None:
+                        for idx in idxs:
+                            cowrites.add((fld, idx))
+            elif isinstance(n, ast.Call):
+                fn = n.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                ):
+                    helper_calls.append((n.lineno, fn.attr))
+    return cowrites, helper_calls
+
+
+def check_typestate(files: Dict[str, SourceFile]) -> List[Finding]:
+    graph = CallGraph(files)
+    write_sets = _function_write_sets(graph)
+    findings: List[Finding] = []
+
+    for ci in graph.classes.values():
+        spec = _parse_spec(ci.sf, ci.node, findings)
+        if spec is None:
+            continue
+        sf = ci.sf
+        release_ok = set(spec.release_writes) | {spec.retained_field}
+        for meth in ci.node.body:
+            if (
+                not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or meth.name == "__init__"
+            ):
+                continue
+            acquired_any = False
+            for block, transitions in _innermost_transitions(
+                meth, spec
+            ).values():
+                cowrites, helper_calls = _block_facts(block, spec)
+
+                def helper_writes(fld: str) -> bool:
+                    for _, attr in helper_calls:
+                        mkey = ci.methods.get(attr)
+                        if mkey and fld in write_sets.get(mkey, ()):
+                            return True
+                    return False
+
+                # double-free: two releases of one index, no re-acquire
+                # between them
+                by_idx: Dict[str, List[_BlockWrite]] = {}
+                for t in transitions:
+                    by_idx.setdefault(t.index, []).append(t)
+                for idx, ts in by_idx.items():
+                    ts.sort(key=lambda t: t.line)
+                    for prev, cur in zip(ts, ts[1:]):
+                        if prev.is_none and cur.is_none:
+                            findings.append(
+                                apply_suppression(
+                                    sf,
+                                    Finding(
+                                        RULE_DOUBLE_FREE,
+                                        sf.rel,
+                                        cur.line,
+                                        f"{ci.name}.{spec.owner}[{idx}] "
+                                        f"freed twice (also at line "
+                                        f"{prev.line}) with no re-acquire "
+                                        f"between — the second free can "
+                                        f"clobber a re-admitted slot",
+                                    ),
+                                )
+                            )
+
+                for t in transitions:
+                    # a later re-acquire of the same index re-opens the
+                    # slot: writes past it are the new owner's, not
+                    # use-after-free
+                    reacquire = min(
+                        (
+                            x.line
+                            for x in by_idx.get(t.index, ())
+                            if x.line > t.line and not x.is_none
+                        ),
+                        default=None,
+                    )
+                    if t.is_none:
+                        # release must settle the retained prefix length
+                        if (
+                            (spec.retained_field, t.index) not in cowrites
+                            and not helper_writes(spec.retained_field)
+                        ):
+                            findings.append(
+                                apply_suppression(
+                                    sf,
+                                    Finding(
+                                        RULE_LIFECYCLE,
+                                        sf.rel,
+                                        t.line,
+                                        f"{ci.name}.{spec.owner}"
+                                        f"[{t.index}] freed without "
+                                        f"settling "
+                                        f"{spec.retained_field}[{t.index}]"
+                                        f" — the next reuse pass reads a "
+                                        f"stale retained prefix length",
+                                    ),
+                                )
+                            )
+                        # use-after-free: non-release bookkeeping written
+                        # for this index after the free
+                        for fld, idx in sorted(cowrites):
+                            if (
+                                idx == t.index
+                                and fld in spec.acquire_writes
+                                and fld not in release_ok
+                            ):
+                                line = _first_write_after(
+                                    block, fld, idx, t.line
+                                )
+                                if line is not None and (
+                                    reacquire is None or line < reacquire
+                                ):
+                                    findings.append(
+                                        apply_suppression(
+                                            sf,
+                                            Finding(
+                                                RULE_LIFECYCLE,
+                                                sf.rel,
+                                                line,
+                                                f"{ci.name}.{fld}"
+                                                f"[{idx}] written after "
+                                                f"{spec.owner}[{idx}] was "
+                                                f"freed at line {t.line} "
+                                                f"— bookkeeping for a "
+                                                f"slot this path no "
+                                                f"longer owns",
+                                            ),
+                                        )
+                                    )
+                    else:
+                        acquired_any = True
+                        missing = [
+                            fld
+                            for fld in spec.acquire_writes
+                            if (fld, t.index) not in cowrites
+                            and not helper_writes(fld)
+                        ]
+                        if missing:
+                            findings.append(
+                                apply_suppression(
+                                    sf,
+                                    Finding(
+                                        RULE_LIFECYCLE,
+                                        sf.rel,
+                                        t.line,
+                                        f"{ci.name}.{spec.owner}"
+                                        f"[{t.index}] acquired without "
+                                        f"settling {missing} for the "
+                                        f"same index — the slot decodes "
+                                        f"with stale per-slot state "
+                                        f"(reservation/bookkeeping "
+                                        f"leak)",
+                                    ),
+                                )
+                            )
+            if acquired_any and _true_loads(meth, spec.retained_field):
+                if not _true_loads(meth, spec.version_field):
+                    findings.append(
+                        apply_suppression(
+                            sf,
+                            Finding(
+                                RULE_UNVERSIONED,
+                                sf.rel,
+                                meth.lineno,
+                                f"{ci.name}.{meth.name} acquires slots "
+                                f"and reads {spec.retained_field} but "
+                                f"never consults {spec.version_field} — "
+                                f"a retained row can be reused across a "
+                                f"weight publish without a version "
+                                f"check",
+                            ),
+                        )
+                    )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def _first_write_after(
+    block: List[ast.stmt], fld: str, idx: str, after_line: int
+) -> Optional[int]:
+    best: Optional[int] = None
+    for stmt in block:
+        for n in ast.walk(stmt):
+            if not isinstance(n, ast.Assign) or n.lineno <= after_line:
+                continue
+            for f2, i2, _ in _subscript_write(n):
+                if f2 == fld and i2 == idx:
+                    if best is None or n.lineno < best:
+                        best = n.lineno
+    return best
+
+
+def _true_loads(meth: ast.AST, fld: str) -> bool:
+    """A genuine read of self.<fld>: an Attribute Load that is not merely
+    the base of a subscript STORE (``self.x[i] = v`` loads ``self.x`` per
+    the AST but writes semantically)."""
+    store_bases = set()
+    for n in ast.walk(meth):
+        if isinstance(n, ast.Subscript) and isinstance(
+            n.ctx, (ast.Store, ast.Del)
+        ):
+            store_bases.add(id(n.value))
+    for n in ast.walk(meth):
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == fld
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "self"
+            and isinstance(n.ctx, ast.Load)
+            and id(n) not in store_bases
+        ):
+            return True
+    return False

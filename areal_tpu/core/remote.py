@@ -240,29 +240,35 @@ class RemoteInfEngine(InferenceEngine):
             return self._version
 
     # --- scheduling ---
+    def _choose_locked(self) -> str:  # holds: _lock
+        if self.config.schedule_policy == "least_requests":
+            # read the table under the lock, not from inside the
+            # lambda (a closure offers no static guarantee about when
+            # it runs relative to the lock)
+            inflight = self._inflight
+            return min(self.addresses, key=lambda a: inflight.get(a, 0))
+        addr = self.addresses[self._server_idx % len(self.addresses)]
+        self._server_idx += 1
+        return addr
+
     def choose_server(self) -> str:
         with self._lock:
-            if self.config.schedule_policy == "least_requests":
-                # read the table under the lock, not from inside the
-                # lambda (a closure offers no static guarantee about when
-                # it runs relative to the lock)
-                inflight = self._inflight
-                return min(self.addresses, key=lambda a: inflight.get(a, 0))
-            addr = self.addresses[self._server_idx % len(self.addresses)]
-            self._server_idx += 1
-            return addr
+            return self._choose_locked()
 
     def _server_for_rid(self, rid: str) -> str:
+        # single critical section: the lookup-miss -> choose -> insert
+        # sequence must be atomic, or two threads racing on the same rid
+        # can pin it to different servers and split its KV affinity
+        # (areal-lint C5 atomicity-split)
         with self._lock:
             if rid in self._rid_to_addr:
                 self._rid_to_addr.move_to_end(rid)
                 return self._rid_to_addr[rid]
-        addr = self.choose_server()
-        with self._lock:
+            addr = self._choose_locked()
             if len(self._rid_to_addr) >= RID_CACHE_SIZE:
                 self._rid_to_addr.popitem(last=False)
             self._rid_to_addr[rid] = addr
-        return addr
+            return addr
 
     # --- generation with interruption loop ---
     async def agenerate(self, req: ModelRequest) -> ModelResponse:

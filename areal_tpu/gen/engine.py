@@ -198,11 +198,46 @@ class GenRequest:
 class GenEngine:
     # lock-discipline contract (areal-lint C1; runtime-validated under
     # AREAL_DEBUG_LOCKS=1): the worker thread and control threads (abort,
-    # weight publish) hand requests across exactly these two fields, so
-    # every touch must hold _lock.  Slot arrays (slot_req, lengths,
-    # retained_len, ...) are worker-owned between the documented lock
-    # sections and stay outside the contract.
-    _GUARDED_FIELDS = {"_holdback": "_lock", "_abort_gen": "_lock"}
+    # weight publish) hand requests across these fields, so every touch
+    # must hold _lock.  The tiered-decode state joined the contract in
+    # ISSUE 9: _dev_state/_state_dirty are the device mirror handoff
+    # (abort/free/admission dirties them from control threads while the
+    # decode loop consumes them) and _next_stream is the stream-id
+    # allocator shared by all admission paths.  Slot arrays (slot_req,
+    # lengths, retained_len, ...) are worker-owned between the documented
+    # lock sections and stay outside the contract.
+    _GUARDED_FIELDS = {
+        "_holdback": "_lock",
+        "_abort_gen": "_lock",
+        "_state_dirty": "_lock",
+        "_dev_state": "_lock",
+        "_next_stream": "_lock",
+    }
+
+    # slot lifecycle automaton (areal-lint C7): slot s is owned iff
+    # slot_req[s] is not None; an acquire must settle every per-slot
+    # array below for the same index in the same block (or via a helper
+    # whose transitive write set covers it); a release must settle the
+    # retained prefix length; _reserved_until/kv_version/_slot_vlm remain
+    # writable on freed slots (abort reservations, migration sources).
+    _SLOT_TYPESTATE = {
+        "owner": "slot_req",
+        "acquire_writes": [
+            "lengths",
+            "rope_pos",
+            "last_tokens",
+            "temperature",
+            "top_p",
+            "top_k",
+            "retained_len",
+            "_reserved_until",
+            "kv_version",
+            "stream_ids",
+        ],
+        "release_writes": ["_reserved_until", "kv_version", "_slot_vlm"],
+        "version_field": "kv_version",
+        "retained_field": "retained_len",
+    }
 
     def __init__(
         self,
@@ -629,13 +664,17 @@ class GenEngine:
         same prompt + accumulated tokens within an RTT, and handing the
         slot to a fresh prompt first would overwrite the retained prefix
         exactly when it is most valuable (the r4 abort-storm thrash)."""
-        n = 0
         deadline = time.monotonic() + self.abort_reserve_s
+        # finish() runs user on_done callbacks and wakes waiters; calling
+        # it under _lock deadlocks any callback that re-enters the engine
+        # (areal-lint C5 blocking-under-lock) — collect under the lock,
+        # call after release
+        to_finish: List[GenRequest] = []
         with self._lock:
             self._abort_gen += 1  # a racing _admit must drop its leftovers
             for s, req in enumerate(self.slot_req):
                 if req is not None:
-                    req.finish(reason)
+                    to_finish.append(req)
                     self.slot_req[s] = None
                     # retained prefix makes the client's resubmission (same
                     # prompt + accumulated tokens) a suffix-only prefill
@@ -653,19 +692,17 @@ class GenEngine:
                         and self.retained_len[s] > self.reuse_min_tokens
                     ):
                         self._reserved_until[s] = deadline
-                    n += 1
             self._state_dirty = True
-            for req in self._holdback:
-                req.finish(reason)
-                n += 1
+            to_finish.extend(self._holdback)
             self._holdback = []
             while True:
                 try:
-                    self.pending.get_nowait().finish(reason)
-                    n += 1
+                    to_finish.append(self.pending.get_nowait())
                 except queue.Empty:
                     break
-        return n
+        for req in to_finish:
+            req.finish(reason)
+        return len(to_finish)
 
     def load_weights(
         self, path: Optional[str] = None, params=None, version: Optional[int] = None
@@ -818,8 +855,9 @@ class GenEngine:
         self.abort_all("abort")
         self.cache = None
         self._standby = None
-        self._dev_state = None  # rebuilt from host mirrors at restage
-        self._state_dirty = True
+        with self._lock:
+            self._dev_state = None  # rebuilt from host mirrors at restage
+            self._state_dirty = True
         self.retained_len[:] = 0  # cache is gone; no prefix survives
         self._reserved_until[:] = 0.0
         self.kv_version[:] = self.version
@@ -1226,16 +1264,22 @@ class GenEngine:
                     # first member to land a slot becomes the cluster's
                     # representative; later members fan out from it
                     clusters[cid]["rep_slot"] = s
+        finish_aborted: List[GenRequest] = []
         with self._lock:
             if self._abort_gen != abort_gen:
                 # an abort_all landed mid-pass and already finished every
                 # request it could see; the ones we drained would otherwise
-                # be resurrected behind their terminal callback
-                for req in leftover:
-                    req.finish("abort")
+                # be resurrected behind their terminal callback.  finish()
+                # runs user callbacks — defer it past the lock (C5)
+                finish_aborted = leftover
                 leftover = []
             else:
-                self._holdback = leftover
+                # merge, don't overwrite: a concurrent submit may have
+                # repopulated _holdback since the intake swap (C5
+                # atomicity-split on the guarded field)
+                self._holdback = leftover + self._holdback
+        for req in finish_aborted:
+            req.finish("abort")
         if leftover and not (
             admitted or reuse_admitted or vlm_admitted or shared_admitted
         ):
@@ -1613,16 +1657,20 @@ class GenEngine:
             req.finish(reason)
 
     def tier_occupancy(self) -> List[int]:
-        """Active slots per length-cohort tier (metrics surface)."""
-        return [
-            sum(
-                self.slot_req[s] is not None
-                for s in range(
-                    self.tier_start[t], self.tier_start[t] + self.tier_size[t]
+        """Active slots per length-cohort tier (metrics surface).  Called
+        from the server's metrics thread while the worker mutates
+        slot_req — snapshot under the lock."""
+        with self._lock:
+            return [
+                sum(
+                    self.slot_req[s] is not None
+                    for s in range(
+                        self.tier_start[t],
+                        self.tier_start[t] + self.tier_size[t],
+                    )
                 )
-            )
-            for t in range(self.n_tiers)
-        ]
+                for t in range(self.n_tiers)
+            ]
 
     def decode_attended_fraction(self) -> float:
         """Attended span / configured ceiling over all decode dispatches:
@@ -1715,7 +1763,7 @@ class GenEngine:
                 self.stats["tier_migrations"] += 1
             self._state_dirty = True
 
-    def _sync_device_state(self) -> None:
+    def _sync_device_state(self) -> None:  # holds: _lock
         """(Re)build the device-resident decode state from the host
         bookkeeping mirrors.  Runs only when a host-side mutation
         (admission, free, migration, abort) dirtied the mirrors — the
@@ -1758,11 +1806,14 @@ class GenEngine:
         self._plan_migrations(n)
         with self._lock:
             active = [s for s in range(self.n_slots) if self.slot_req[s] is not None]
-        if not active:
-            return 0
-        if self._dev_state is None or self._state_dirty:
-            self._sync_device_state()
-        st = self._dev_state
+            if not active:
+                return 0
+            # dirty-check + rebuild + snapshot are one atomic unit: an
+            # abort/free landing between them would leave this chunk
+            # decoding from stale device mirrors
+            if self._dev_state is None or self._state_dirty:
+                self._sync_device_state()
+            st = self._dev_state
         S = self.n_slots + 1
         # per-tier dispatch: only tiers holding an active slot run; each
         # gets a key window bucketed from ITS occupants' spans
@@ -1810,8 +1861,9 @@ class GenEngine:
                 dev_outs.append((t, out_t))
         except Exception:
             # a failed dispatch may have consumed (donated) device state
-            self._dev_state = None
-            self._state_dirty = True
+            with self._lock:
+                self._dev_state = None
+                self._state_dirty = True
             raise
         toks = np.zeros((n, S), np.int32)
         logps = np.zeros((n, S), np.float32)

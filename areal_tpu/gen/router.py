@@ -77,6 +77,10 @@ class Router:
     # checks are atomic with lease grants (areal-lint C1; the asyncio
     # flavor of the runtime check degrades to a locked() probe)
     _GUARDED_FIELDS = {"_running": "_lock", "_accepted": "_lock"}
+    # declared acquisition order (areal-lint C5): _flush_and_update holds
+    # the flush serializer across the backend fan-out, then takes the
+    # ledger lock to commit — never nest them the other way around
+    # lock-order: _flush_lock -> _lock
 
     def __init__(self, config: RouterConfig, addresses: Optional[List[str]] = None):
         self.config = config

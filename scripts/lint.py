@@ -1,16 +1,28 @@
-"""areal-lint CLI: run the project static-analysis suite (ISSUE 3).
+"""areal-lint CLI: run the project static-analysis suite (ISSUE 3/9).
 
-    python scripts/lint.py              # report all findings
-    python scripts/lint.py --check     # exit 1 on unsuppressed findings
-                                        # (the tier-1 gate semantics)
-    python scripts/lint.py --suppressed # also list suppressed findings
+    python scripts/lint.py                  # report all findings
+    python scripts/lint.py --check          # exit 1 on unsuppressed findings
+                                            # (the tier-1 gate semantics)
+    python scripts/lint.py --suppressed     # also list suppressed findings
+    python scripts/lint.py --format json    # machine-readable output
+    python scripts/lint.py --format sarif   # SARIF 2.1.0 (CI diff annotation)
+    python scripts/lint.py --write-baseline lint-baseline.json
+    python scripts/lint.py --baseline lint-baseline.json --check
+                                            # only NEW findings fail
+    python scripts/lint.py --write-budget   # regenerate the C6 signature
+                                            # budget (analysis/signature_budget.json)
+
+Baseline fingerprints are (path, rule, message) hashes — stable across
+unrelated line drift, invalidated when the finding itself changes.
 
 Checker catalog, annotation syntax (`_GUARDED_FIELDS`, `# guarded-by:`,
-`# holds:`, `# areal-lint: hot-path`) and the suppression format
-(`# areal-lint: disable=<rule> <reason>`): docs/lint.md.
+`# holds:`, `# lock-order:`, `_SLOT_TYPESTATE`, `# areal-lint: hot-path`)
+and the suppression format (`# areal-lint: disable=<rule> <reason>`):
+docs/lint.md.
 """
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -18,6 +30,88 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from areal_tpu.analysis import run_suite, unsuppressed  # noqa: E402
+from areal_tpu.analysis.jit_signatures import (  # noqa: E402
+    BUDGET_PATH,
+    render_budget_doc,
+)
+
+# The engine configs the jit-cache soak tests run with; the budgets derived
+# from these are what the soak tests assert observed program counts against.
+REFERENCE_CONFIGS = {
+    "tiered_decode_soak": {
+        "n_slots": 4,
+        "max_seq_len": 256,
+        "prompt_bucket": 16,
+        "decode_tiers": 2,
+    },
+    "group_fanout_soak": {
+        "n_slots": 8,
+        "max_seq_len": 256,
+        "prompt_bucket": 16,
+        "decode_tiers": 1,
+    },
+}
+
+
+def fingerprint(f) -> str:
+    """Line-drift-stable identity of a finding for baseline mode."""
+    h = hashlib.sha256(
+        f"{f.path}\x00{f.rule}\x00{f.message}".encode("utf-8")
+    )
+    return h.hexdigest()[:16]
+
+
+def to_sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 payload (github/codeql-action/upload-sarif)."""
+    rules = sorted({f.rule for f in findings})
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "areal-lint",
+                        "informationUri": "docs/lint.md",
+                        "rules": [{"id": r} for r in rules],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "partialFingerprints": {
+                            "arealLint/v1": fingerprint(f)
+                        },
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": max(1, f.line)
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
+def write_budget(root: str) -> str:
+    path = os.path.join(root, BUDGET_PATH)
+    doc = render_budget_doc(REFERENCE_CONFIGS)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
 
 
 def main(argv=None) -> int:
@@ -30,38 +124,96 @@ def main(argv=None) -> int:
     p.add_argument(
         "--check",
         action="store_true",
-        help="exit nonzero when any unsuppressed finding exists",
+        help="exit nonzero when any unsuppressed (non-baselined) finding "
+        "exists",
     )
     p.add_argument(
         "--suppressed",
         action="store_true",
         help="also print suppressed findings (they are always counted)",
     )
-    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--format",
+        choices=("text", "json", "sarif"),
+        default="text",
+        help="output format (sarif feeds CI diff annotation)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="deprecated alias for --format json",
+    )
+    p.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="suppress findings whose fingerprint appears in FILE; only "
+        "new findings are reported / fail --check",
+    )
+    p.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write the current unsuppressed findings' fingerprints to "
+        "FILE and exit",
+    )
+    p.add_argument(
+        "--write-budget",
+        action="store_true",
+        help="regenerate areal_tpu/analysis/signature_budget.json from the "
+        "reference soak configs and exit",
+    )
     args = p.parse_args(argv)
+
+    if args.write_budget:
+        path = write_budget(args.root)
+        print(f"wrote {path}")
+        return 0
 
     findings = run_suite(args.root)
     active = unsuppressed(findings)
     suppressed = [f for f in findings if f.suppressed]
 
-    if args.json:
+    if args.write_baseline:
+        payload = {
+            "comment": "areal-lint baseline: fingerprints of accepted "
+            "pre-existing findings; new findings still fail --check",
+            "fingerprints": sorted({fingerprint(f) for f in active}),
+        }
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        print(f"wrote {len(payload['fingerprints'])} fingerprint(s)")
+        return 0
+
+    baselined = []
+    if args.baseline:
+        with open(args.baseline, encoding="utf-8") as f:
+            known = set(json.load(f).get("fingerprints", []))
+        baselined = [f for f in active if fingerprint(f) in known]
+        active = [f for f in active if fingerprint(f) not in known]
+
+    fmt = "json" if args.json else args.format
+    if fmt == "json":
         print(
             json.dumps(
                 {
                     "findings": [vars(f) for f in active],
                     "suppressed": [vars(f) for f in suppressed],
+                    "baselined": [vars(f) for f in baselined],
                 }
             )
         )
+    elif fmt == "sarif":
+        print(json.dumps(to_sarif(active), indent=2))
     else:
         for f in active:
             print(f.render())
         if args.suppressed:
             for f in suppressed:
                 print(f.render())
+        tail = f", {len(baselined)} baselined" if args.baseline else ""
         print(
             f"areal-lint: {len(active)} finding(s), "
-            f"{len(suppressed)} suppressed"
+            f"{len(suppressed)} suppressed{tail}"
         )
     if args.check and active:
         return 1

@@ -1,0 +1,53 @@
+"""C6 negative fixture: every static argument provably on the ladder."""
+# areal-lint: hot-path (C6 fixture: jitted callables live here)
+
+import jax
+
+from areal_tpu.utils.datapack import round_up_to_bucket
+
+
+def _decode(params, tokens, n, key_window):
+    return tokens
+
+
+def _gae(arrs, out_len, gamma):
+    return arrs
+
+
+_gae_fn = jax.jit(_gae, static_argnums=(2,))
+
+
+def run_gae(arrs, cfg):
+    # config attribute chains are engine-lifetime constants
+    return _gae_fn(arrs, 0, cfg.gamma)
+
+
+class Engine:
+    def __init__(self):
+        self.max_seq_len = 256
+        self.bucket = 16
+        self.tier_bounds = [64, 256]
+        self._decode_fn = jax.jit(_decode, static_argnums=(3,))
+
+    def bucketed(self, tokens, span):
+        kw = round_up_to_bucket(span + 1, self.bucket, self.max_seq_len)
+        return self._decode_fn(None, tokens, 4, kw)
+
+    def config_window(self, tokens):
+        return self._decode_fn(None, tokens, 4, self.max_seq_len)
+
+    def tiered(self, tokens, t, full):
+        kw = (
+            self.max_seq_len
+            if full
+            else min(self.tier_bounds[t], self.max_seq_len)
+        )
+        return self._decode_fn(None, tokens, 4, kw)
+
+    def windowed(self, tokens, key_window=0):
+        # parameter: the resolved caller passes nothing; the default (a
+        # sentinel 0) applies
+        return self._decode_fn(None, tokens, 4, key_window)
+
+    def outer(self, tokens):
+        return self.windowed(tokens)

@@ -1,0 +1,32 @@
+"""C6 positive fixture: every VIOLATION-marked line must be flagged."""
+# areal-lint: hot-path (C6 fixture: jitted callables live here)
+
+import jax
+
+
+def _decode(params, tokens, n, key_window):
+    return tokens
+
+
+class Engine:
+    def __init__(self):
+        self.max_seq_len = 256
+        self.bucket = 16
+        self._decode_fn = jax.jit(_decode, static_argnums=(3,))
+
+    def bad_literal(self, tokens):
+        return self._decode_fn(None, tokens, 4, 100)  # VIOLATION off-ladder
+
+    def bad_arith(self, tokens, span):
+        kw = span + 4
+        return self._decode_fn(None, tokens, 4, kw)  # VIOLATION off-ladder
+
+    def bad_len(self, tokens):
+        return self._decode_fn(None, tokens, 4, len(tokens))  # VIOLATION
+
+    def helper(self, tokens, kw):
+        return self._decode_fn(None, tokens, 4, kw)  # VIOLATION (caller)
+
+    def caller(self, tokens, span):
+        # the unsafe value flows through helper's parameter
+        return self.helper(tokens, span * 2)

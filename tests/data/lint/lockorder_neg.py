@@ -1,0 +1,67 @@
+"""C5 negative fixture: sanctioned patterns that must stay clean.
+
+Declared nesting order, RLock re-entrancy, collect-then-call callback
+delivery, read-modify-write under the second hold (atomicity exempt),
+constant reset writes, and awaiting while holding only an asyncio lock.
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Pipeline:
+    _GUARDED_FIELDS = {"_queue": "_state"}
+    # lock-order: _flush -> _state
+
+    def __init__(self):
+        self._flush = threading.Lock()
+        self._state = threading.RLock()
+        self._queue = []
+
+    def flush(self):
+        # declared order: the serializer wraps the state commit
+        with self._flush:
+            staged = self.compute()
+            with self._state:
+                self._queue.extend(staged)
+
+    def compute(self):
+        return [1]
+
+    def reentrant_ok(self):
+        with self._state:
+            with self._state:  # RLock: legal re-entry
+                return len(self._queue)
+
+    def drain(self, reason):
+        # collect-then-call: callbacks run after the lock is released
+        with self._state:
+            drained = list(self._queue)
+            self._queue = []  # constant-free reset is a fresh list, but
+            # the value never depends on the stale read above
+        for req in drained:
+            req.finish(reason)
+
+    def merge(self, extra):
+        with self._state:
+            leftover = list(self._queue)
+        combined = leftover + extra
+        with self._state:
+            # RMW under the second hold re-validates: exempt
+            self._queue = combined + self._queue
+
+    def sleep_unlocked(self):
+        time.sleep(0.001)
+        with self._state:
+            return len(self._queue)
+
+
+class AioLedger:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+
+    async def commit(self):
+        async with self._alock:
+            # holding an asyncio lock across await is the normal idiom
+            await asyncio.sleep(0)

@@ -1,0 +1,79 @@
+"""C5 positive fixture: every VIOLATION-marked line must be flagged.
+
+Covers the full rule family: a two-lock acquisition cycle, lexical and
+through-a-callee re-acquisition of a non-reentrant lock, asyncio nesting,
+await / blocking-call / user-callback / blocking-callee under a threading
+lock, and the check-then-act atomicity split on a guarded field.
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Worker:
+    _GUARDED_FIELDS = {"_jobs": "_lock_a"}
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self._jobs = []
+
+    def ab(self):
+        with self._lock_a:
+            with self._lock_b:  # VIOLATION lock-order (cycle with ba)
+                pass
+
+    def ba(self):
+        with self._lock_b:
+            with self._lock_a:  # VIOLATION lock-order (cycle with ab)
+                pass
+
+    def reenter(self):
+        with self._lock_a:
+            with self._lock_a:  # VIOLATION lock-order (re-acquire)
+                pass
+
+    def reenter_via_helper(self):
+        with self._lock_a:
+            self.locked_len()  # VIOLATION lock-order (callee re-acquires)
+
+    def locked_len(self):
+        with self._lock_a:
+            return len(self._jobs)
+
+    def sleeps_locked(self):
+        with self._lock_a:
+            time.sleep(0.01)  # VIOLATION blocking-under-lock
+
+    def finishes_locked(self, req):
+        with self._lock_a:
+            req.finish("abort")  # VIOLATION blocking-under-lock (callback)
+
+    def calls_blocker_locked(self):
+        with self._lock_b:
+            self.do_io()  # VIOLATION blocking-under-lock (via callee)
+
+    def do_io(self):
+        time.sleep(0.01)
+
+    async def awaits_locked(self):
+        with self._lock_a:
+            await asyncio.sleep(0)  # VIOLATION blocking-under-lock (await)
+
+    def split_overwrite(self, extra):
+        with self._lock_a:
+            jobs = list(self._jobs)
+        merged = jobs + extra
+        with self._lock_a:
+            self._jobs = merged  # VIOLATION atomicity-split
+
+
+class AioPool:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+
+    async def nested(self):
+        async with self._alock:
+            async with self._alock:  # VIOLATION lock-order (asyncio)
+                pass

@@ -1,0 +1,67 @@
+"""C7 negative fixture: lifecycle-correct transitions that must stay
+clean — full co-writes, helper delegation, the tuple-loop migration
+idiom, re-acquire between frees, and version-checked retained reuse."""
+
+
+class Pool:
+    _SLOT_TYPESTATE = {
+        "owner": "slot_req",
+        "acquire_writes": ["lengths", "temperature"],
+        "release_writes": ["_reserved_until"],
+        "version_field": "kv_version",
+        "retained_field": "retained_len",
+    }
+
+    def __init__(self, n):
+        self.slot_req = [None] * n
+        self.lengths = [0] * n
+        self.temperature = [1.0] * n
+        self.retained_len = [0] * n
+        self.kv_version = [0] * n
+        self._reserved_until = [0.0] * n
+        self.version = 0
+
+    def acquire(self, s, req):
+        self.slot_req[s] = req
+        self.lengths[s] = len(req)
+        self.temperature[s] = 1.0
+
+    def acquire_via_helper(self, s, req):
+        self.slot_req[s] = req
+        self.lengths[s] = len(req)
+        self._warm(s)
+
+    def _warm(self, s):
+        self.temperature[s] = 0.7
+
+    def release(self, s):
+        self.slot_req[s] = None
+        self.retained_len[s] = self.lengths[s]
+        # reserving a freed slot is release-side bookkeeping
+        self._reserved_until[s] = 1.0
+
+    def free_then_readmit(self, s, req):
+        self.slot_req[s] = None
+        self.retained_len[s] = self.lengths[s]
+        self.slot_req[s] = req  # re-acquire: not a double free
+        self.lengths[s] = len(req)
+        self.temperature[s] = 1.0
+
+    def migrate(self, s, dst, req):
+        self.slot_req[dst] = req
+        self.slot_req[s] = None
+        for arr in (self.lengths, self.temperature):
+            arr[dst] = arr[s]
+        self.retained_len[dst] = 0
+        self._reserved_until[dst] = 0.0
+        self.kv_version[dst] = self.version
+        self.retained_len[s] = self.lengths[s]
+
+    def reuse_versioned(self, s, req):
+        if (
+            self.retained_len[s] > 4
+            and self.kv_version[s] == self.version
+        ):
+            self.slot_req[s] = req
+            self.lengths[s] = self.retained_len[s]
+            self.temperature[s] = 1.0

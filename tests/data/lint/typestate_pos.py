@@ -1,0 +1,41 @@
+"""C7 positive fixture: every VIOLATION-marked line must be flagged."""
+
+
+class Pool:
+    _SLOT_TYPESTATE = {
+        "owner": "slot_req",
+        "acquire_writes": ["lengths", "temperature"],
+        "release_writes": ["_reserved_until"],
+        "version_field": "kv_version",
+        "retained_field": "retained_len",
+    }
+
+    def __init__(self, n):
+        self.slot_req = [None] * n
+        self.lengths = [0] * n
+        self.temperature = [1.0] * n
+        self.retained_len = [0] * n
+        self.kv_version = [0] * n
+        self._reserved_until = [0.0] * n
+
+    def double_free(self, s):
+        self.slot_req[s] = None
+        self.retained_len[s] = self.lengths[s]
+        self.slot_req[s] = None  # VIOLATION slot-double-free
+
+    def leaky_acquire(self, s, req):
+        self.slot_req[s] = req  # VIOLATION slot-lifecycle (missing writes)
+
+    def free_without_retain(self, s):
+        self.slot_req[s] = None  # VIOLATION slot-lifecycle (no retained)
+
+    def write_after_free(self, s):
+        self.slot_req[s] = None
+        self.retained_len[s] = self.lengths[s]
+        self.lengths[s] = 0  # VIOLATION slot-lifecycle (use after free)
+
+    def reuse_unversioned(self, s, req):  # VIOLATION retained-unversioned
+        if self.retained_len[s] > 4:
+            self.slot_req[s] = req
+            self.lengths[s] = self.retained_len[s]
+            self.temperature[s] = 1.0

@@ -279,6 +279,22 @@ def test_no_new_compile_signatures_in_steady_state(setup):
     assert eng._prefill_fn._cache_size() == sizes["prefill"]
     assert eng._suffix_prefill_fn._cache_size() == sizes["suffix"]
 
+    # ISSUE 9: cross-check against the checked-in C6 signature budget —
+    # the static ladder proof and this runtime soak must agree
+    # (regenerate with `python scripts/lint.py --write-budget`).
+    import json
+
+    from areal_tpu.analysis.jit_signatures import BUDGET_PATH
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, BUDGET_PATH)) as f:
+        ref = json.load(f)["reference_configs"]["group_fanout_soak"]
+    assert ref["config"] == {"n_slots": 8, "max_seq_len": 256,
+                             "prompt_bucket": 16, "decode_tiers": 1}
+    assert eng._prefill_fn._cache_size() <= ref["budgets"]["prefill"]
+    assert (eng._suffix_prefill_fn._cache_size()
+            <= ref["budgets"]["suffix_prefill"])
+
 
 def test_abort_reservation_strictly_greater_threshold(setup):
     """ADVICE r5: a slot whose retained_len == reuse_min_tokens must NOT be

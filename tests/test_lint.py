@@ -1,9 +1,12 @@
-"""areal-lint (ISSUE 3): fixture coverage for all four checkers, the
-delete-the-lock mutation acceptance case (fixture AND real engine), the
-suppression-hygiene rules, the AREAL_DEBUG_LOCKS runtime assertions, and
+"""areal-lint (ISSUE 3 + ISSUE 9): fixture coverage for all seven
+checkers, the mutation acceptance cases (fixture AND real engine/router:
+deleted locks, reordered acquisitions, off-ladder statics, double-free),
+the signature-budget math cross-checks, the suppression-hygiene rules,
+the AREAL_DEBUG_LOCKS runtime assertions, the CLI output formats, and
 the tier-1 repo-clean gate."""
 
 import asyncio
+import json
 import os
 import threading
 
@@ -19,8 +22,18 @@ from areal_tpu.analysis.core import (
 )
 from areal_tpu.analysis.dead_modules import check_dead_modules
 from areal_tpu.analysis.host_sync import check_host_sync
+from areal_tpu.analysis.jit_signatures import (
+    BUDGET_PATH,
+    budget_drift,
+    check_jit_signatures,
+    compute_budgets,
+    ladder_values,
+    pow2_row_counts,
+)
 from areal_tpu.analysis.lock_discipline import check_lock_discipline
+from areal_tpu.analysis.lock_order import check_lock_order
 from areal_tpu.analysis.lockcheck import LockDisciplineError, lock_guarded
+from areal_tpu.analysis.typestate import check_typestate
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "data", "lint")
@@ -326,6 +339,267 @@ def test_gen_engine_annotations_match_runtime(monkeypatch):
     assert eng.abort_all() == 0  # abort path touches both guarded fields
     with eng._lock:
         assert eng._holdback == []
+
+
+# ------------------------------- C5 ---------------------------------
+
+
+def _violation_lines(name: str) -> set:
+    src = open(os.path.join(FIXTURES, name + ".py")).read()
+    return {
+        i + 1
+        for i, line in enumerate(src.split("\n"))
+        if "# VIOLATION" in line
+    }
+
+
+def test_lockorder_positive_fixture():
+    sf = _fixture("lockorder_pos")
+    findings = check_lock_order({"lockorder_pos": sf})
+    assert {f.line for f in findings} == _violation_lines("lockorder_pos")
+    rules = {f.rule for f in findings}
+    assert rules == {"lock-order", "blocking-under-lock", "atomicity-split"}
+
+
+def test_lockorder_negative_fixture_is_clean():
+    sf = _fixture("lockorder_neg")
+    assert check_lock_order({"lockorder_neg": sf}) == []
+
+
+def test_lock_reorder_is_caught_in_fixture():
+    """Acceptance: inverting the declared `_flush -> _state` nesting in
+    the clean fixture closes a cycle against the declaration."""
+    src = open(os.path.join(FIXTURES, "lockorder_neg.py")).read()
+    mutated = (
+        src.replace("with self._flush:", "with self.__tmp__:")
+        .replace("with self._state:", "with self._flush:")
+        .replace("with self.__tmp__:", "with self._state:")
+    )
+    sf = SourceFile("m", mutated, rel="m")
+    assert sf.tree is not None, sf.error
+    findings = check_lock_order({"m": sf})
+    assert any(
+        f.rule == "lock-order" and "cycle" in f.message for f in findings
+    ), findings
+
+
+def test_lock_reorder_is_caught_in_real_router():
+    """Acceptance: the same inversion against the REAL router — its
+    `# lock-order: _flush_lock -> _lock` declaration makes the swapped
+    nesting in _flush_and_update a cycle."""
+    path = os.path.join(REPO, "areal_tpu", "gen", "router.py")
+    src = open(path).read()
+    assert "async with self._flush_lock:" in src
+    mutated = (
+        src.replace("async with self._flush_lock:", "async with self.__t__:")
+        .replace("async with self._lock:", "async with self._flush_lock:")
+        .replace("async with self.__t__:", "async with self._lock:")
+    )
+    sf = SourceFile("router_mutated", mutated, rel="router_mutated")
+    assert sf.tree is not None, sf.error
+    findings = check_lock_order({"router_mutated": sf})
+    assert any(
+        f.rule == "lock-order" and "cycle" in f.message for f in findings
+    ), findings
+    # the unmutated router is clean under the same single-file analysis
+    clean = SourceFile(path, src, rel="router.py")
+    assert check_lock_order({"router.py": clean}) == []
+
+
+def test_holdback_overwrite_is_caught_in_real_engine():
+    """Acceptance: reverting the _admit fix (merge -> blind overwrite of
+    the guarded _holdback) re-trips the atomicity-split rule."""
+    path = os.path.join(REPO, "areal_tpu", "gen", "engine.py")
+    src = open(path).read()
+    assert "self._holdback = leftover + self._holdback" in src
+    mutated = src.replace(
+        "self._holdback = leftover + self._holdback",
+        "self._holdback = leftover",
+    )
+    findings = check_lock_order(
+        {"engine.py": SourceFile("m", mutated, rel="engine.py")}
+    )
+    assert any(
+        f.rule == "atomicity-split" and "_holdback" in f.message
+        for f in findings
+    ), findings
+    clean = SourceFile(path, src, rel="engine.py")
+    assert (
+        check_lock_order({"engine.py": clean}) == []
+    ), "unmutated engine must be C5-clean"
+
+
+# ------------------------------- C6 ---------------------------------
+
+
+def test_jitsig_positive_fixture():
+    sf = _fixture("jitsig_pos")
+    findings = check_jit_signatures({"jitsig_pos": sf})
+    assert {f.line for f in findings} == _violation_lines("jitsig_pos")
+    assert {f.rule for f in findings} == {"off-ladder-static"}
+
+
+def test_jitsig_negative_fixture_is_clean():
+    sf = _fixture("jitsig_neg")
+    assert check_jit_signatures({"jitsig_neg": sf}) == []
+
+
+def test_jitsig_only_applies_to_hot_files():
+    src = open(os.path.join(FIXTURES, "jitsig_pos.py")).read()
+    cold = src.replace("# areal-lint: hot-path", "#")
+    sf = SourceFile("cold", cold, rel="cold")
+    assert check_jit_signatures({"cold": sf}) == []
+
+
+def test_off_ladder_keywindow_is_caught_in_real_engine():
+    """Acceptance: an out-of-ladder key_window literal in the REAL decode
+    dispatch is caught — the soak tests' runtime assertion, as a static
+    proof."""
+    path = os.path.join(REPO, "areal_tpu", "gen", "engine.py")
+    src = open(path).read()
+    anchor = (
+        "key_window = round_up_to_bucket(\n"
+        "                        span + n, self.prompt_bucket, M\n"
+        "                    )"
+    )
+    assert anchor in src, "decode key_window bucketing moved; update test"
+    mutated = src.replace(anchor, "key_window = 100")
+    findings = check_jit_signatures(
+        {"engine.py": SourceFile("m", mutated, rel="engine.py")}
+    )
+    assert any(
+        f.rule == "off-ladder-static" and "key_window" in f.message
+        for f in findings
+    ), findings
+    clean = SourceFile(path, src, rel="engine.py")
+    assert check_jit_signatures({"engine.py": clean}) == []
+
+
+def test_ladder_mirror_matches_runtime_bucketing():
+    """The pure-python budget math must equal the runtime ladder exactly:
+    the image of round_up_to_bucket over every feasible length is the
+    enumerated ladder, and row padding counts match the pow2 rule."""
+    from areal_tpu.utils.datapack import round_up_to_bucket
+
+    for q, m in ((16, 256), (32, 256), (128, 2048)):
+        image = {round_up_to_bucket(n, q, m) for n in range(1, m + 1)}
+        assert image == set(ladder_values(q, m)), (q, m)
+    for slots in (1, 2, 4, 8, 64):
+        pads = {1 << max(0, (k - 1)).bit_length() for k in range(1, slots + 1)}
+        assert len(pads) == pow2_row_counts(slots), slots
+
+
+def test_signature_budget_is_fresh(repo_findings):
+    """The checked-in budget matches the ladder math (the same condition
+    `signature-budget-stale` enforces), and tampering is detected."""
+    with open(os.path.join(REPO, BUDGET_PATH)) as f:
+        doc = json.load(f)
+    assert budget_drift(doc) == []
+    ref = doc["reference_configs"]["tiered_decode_soak"]
+    assert ref["budgets"] == compute_budgets(ref["config"])
+    tampered = json.loads(json.dumps(doc))
+    tampered["reference_configs"]["tiered_decode_soak"]["budgets"][
+        "decode"
+    ] += 1
+    assert budget_drift(tampered) != []
+
+
+# ------------------------------- C7 ---------------------------------
+
+
+def test_typestate_positive_fixture():
+    sf = _fixture("typestate_pos")
+    findings = check_typestate({"typestate_pos": sf})
+    assert {f.line for f in findings} == _violation_lines("typestate_pos")
+    assert {f.rule for f in findings} == {
+        "slot-double-free",
+        "slot-lifecycle",
+        "retained-unversioned",
+    }
+
+
+def test_typestate_negative_fixture_is_clean():
+    sf = _fixture("typestate_neg")
+    assert check_typestate({"typestate_neg": sf}) == []
+
+
+def test_double_free_is_caught_in_real_engine():
+    """Acceptance: turning _free's retained-prefix settle into a second
+    `slot_req[s] = None` is a double-free of a retained cache row — the
+    exact hazard the radix-refactor must not introduce."""
+    path = os.path.join(REPO, "areal_tpu", "gen", "engine.py")
+    src = open(path).read()
+    anchor = (
+        "self.retained_len[s] = 0 if self._slot_vlm[s] else self.lengths[s]"
+    )
+    assert src.count(anchor) == 1, "update the _free mutation anchor"
+    mutated = src.replace(anchor, "self.slot_req[s] = None")
+    findings = check_typestate(
+        {"engine.py": SourceFile("m", mutated, rel="engine.py")}
+    )
+    assert any(f.rule == "slot-double-free" for f in findings), findings
+    clean = SourceFile(path, src, rel="engine.py")
+    assert check_typestate({"engine.py": clean}) == []
+
+
+# ------------------------------- CLI ---------------------------------
+
+
+def _load_cli():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "areal_lint_cli", os.path.join(REPO, "scripts", "lint.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_sarif_and_fingerprints(repo_findings):
+    cli = _load_cli()
+    active = unsuppressed(repo_findings)
+    # fingerprints are line-drift-stable: same (path, rule, message)
+    # hashes equal regardless of the line attribute
+    for f in repo_findings[:5]:
+        moved = type(f)(f.rule, f.path, f.line + 40, f.message)
+        assert cli.fingerprint(f) == cli.fingerprint(moved)
+    sarif = cli.to_sarif(repo_findings)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert len(results) == len(repo_findings)
+    for r in results:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["region"]["startLine"] >= 1
+        assert r["partialFingerprints"]["arealLint/v1"]
+    assert active == []  # and the repo itself stays SARIF-empty
+
+
+def test_cli_baseline_roundtrip(tmp_path):
+    """--write-baseline then --baseline suppresses exactly the recorded
+    findings; a new finding still fails --check."""
+    cli = _load_cli()
+    from areal_tpu.analysis.core import Finding
+
+    known = Finding("lock-order", "pkg/a.py", 10, "cycle via _lock")
+    new = Finding("lock-order", "pkg/a.py", 20, "cycle via _other")
+    baseline = {"fingerprints": [cli.fingerprint(known)]}
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(baseline))
+    loaded = set(json.loads(bl.read_text())["fingerprints"])
+    assert cli.fingerprint(known) in loaded
+    assert cli.fingerprint(new) not in loaded
+
+
+def test_cli_write_budget_is_idempotent(tmp_path):
+    cli = _load_cli()
+    doc = cli.render_budget_doc(cli.REFERENCE_CONFIGS)
+    with open(os.path.join(REPO, BUDGET_PATH)) as f:
+        checked_in = json.load(f)
+    assert doc == checked_in, (
+        "signature_budget.json is stale — run "
+        "`python scripts/lint.py --write-budget`"
+    )
 
 
 # ------------------------------ the gate -----------------------------

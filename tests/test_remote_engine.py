@@ -36,6 +36,42 @@ def _agen(eng, req):
     return asyncio.run(eng.agenerate(req))
 
 
+def test_rid_affinity_survives_concurrent_first_lookup():
+    """Regression (ISSUE 9 / C5 atomicity-split): _server_for_rid's
+    lookup-miss -> choose -> insert sequence is one critical section.
+    Split across lock releases, threads racing on the SAME rid could pin
+    it to different servers and fracture its KV affinity."""
+    import threading
+
+    cfg = InferenceEngineConfig(
+        experiment_name="e", trial_name="t", consumer_batch_size=2,
+        max_concurrent_rollouts=16, request_timeout=10,
+    )
+    for attempt in range(20):
+        eng = RemoteJaxEngine(cfg)
+        eng.addresses = [f"10.0.0.{i}:80" for i in range(4)]
+        n = 8
+        barrier = threading.Barrier(n)
+        got = [None] * n
+
+        def probe(i):
+            barrier.wait()
+            # churn the round-robin counter AND resolve the shared group
+            eng._server_for_rid(f"solo-{attempt}-{i}")
+            got[i] = eng._server_for_rid("grp")
+
+        threads = [
+            threading.Thread(target=probe, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(set(got)) == 1, (
+            f"rid 'grp' pinned to multiple servers: {set(got)}"
+        )
+
+
 def test_basic_generation(server):
     s, addr = server
     eng = _engine(addr)

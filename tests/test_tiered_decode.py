@@ -54,6 +54,18 @@ def _run(eng, reqs):
     return [(tuple(r.output_tokens), r.stop_reason) for r in reqs]
 
 
+def _signature_budget(name):
+    """Reference entry from the checked-in C6 signature budget (ISSUE 9)."""
+    import json
+    import os
+
+    from areal_tpu.analysis.jit_signatures import BUDGET_PATH
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, BUDGET_PATH)) as f:
+        return json.load(f)["reference_configs"][name]
+
+
 def _mixed_reqs(cfg, rng, temperature):
     return [
         GenRequest(rid=f"r{i}", input_ids=rng.integers(0, 97, n).tolist(),
@@ -192,6 +204,16 @@ def test_compile_signature_soak_stays_on_ladder(setup):
         wave(f"soak{w}")
     assert eng._decode_fn._cache_size() == sizes["decode"]
     assert eng._prefill_fn._cache_size() == sizes["prefill"]
+
+    # ISSUE 9: the checked-in signature budget is the authoritative
+    # ceiling for this reference config — observed program counts must
+    # stay within it, and the config must match what the budget assumed
+    # (regenerate with `python scripts/lint.py --write-budget`).
+    ref = _signature_budget("tiered_decode_soak")
+    assert ref["config"] == {"n_slots": 4, "max_seq_len": 256,
+                             "prompt_bucket": 16, "decode_tiers": 2}
+    assert eng._decode_fn._cache_size() <= ref["budgets"]["decode"]
+    assert eng._prefill_fn._cache_size() <= ref["budgets"]["prefill"]
 
 
 def test_device_resident_state_between_chunks(setup):
