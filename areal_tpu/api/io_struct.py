@@ -42,6 +42,10 @@ class ModelRequest:
     # the engine clusters them for cross-slot KV prefix sharing
     group_id: str = ""
     group_n: int = 0
+    # telemetry (utils/telemetry.py): trajectory-lifecycle trace id, carried
+    # on the wire and echoed in the response meta; survives the interruption
+    # loop's resubmissions because copy() preserves it
+    trace_id: str = ""
 
     def copy(self) -> "ModelRequest":
         return ModelRequest(
@@ -56,6 +60,7 @@ class ModelRequest:
             image_grid_thw=self.image_grid_thw,
             group_id=self.group_id,
             group_n=self.group_n,
+            trace_id=self.trace_id,
         )
 
 

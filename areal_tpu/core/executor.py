@@ -21,7 +21,7 @@ from areal_tpu.api.config import InferenceEngineConfig
 from areal_tpu.api.workflow import RolloutWorkflow
 from areal_tpu.core.runner import AsyncTaskRunner, TaskError, TaskQueueFullError
 from areal_tpu.core.staleness import StalenessManager
-from areal_tpu.utils import logging
+from areal_tpu.utils import logging, telemetry
 from areal_tpu.utils.data import concat_padded_tensors
 from areal_tpu.utils.dataloader import StatefulDataLoader, cycle_dataloader
 
@@ -140,6 +140,12 @@ class WorkflowExecutor:
             finally:
                 if self.fleet_gate is not None:
                     await self.fleet_gate.finish(alloc_id, accepted=accept)
+            if telemetry.is_enabled():
+                telemetry.emit(
+                    "episode",
+                    accepted=accept,
+                    version=self.inference_engine.get_version(),
+                )
             if accept:
                 self.staleness_manager.on_rollout_accepted()
                 if self.config.enable_rollout_tracing:

@@ -38,7 +38,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.api.workflow import RolloutWorkflow
 from areal_tpu.core.executor import WorkflowExecutor
-from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils import logging, name_resolve, names, telemetry
 from areal_tpu.utils.http import arequest_with_retry, get_default_connector
 
 logger = logging.getLogger("remote_engine")
@@ -273,6 +273,8 @@ class RemoteInfEngine(InferenceEngine):
     # --- generation with interruption loop ---
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         req = req.copy()
+        if not req.trace_id:
+            req.trace_id = req.rid
         gconfig = req.gconfig
         if gconfig.n_samples != 1:
             raise ValueError(
@@ -286,6 +288,13 @@ class RemoteInfEngine(InferenceEngine):
         # so the engine can fan their common prefix KV out across slots;
         # the group key (when declared) outranks the per-request rid
         addr = self._server_for_rid(req.group_id or req.rid)
+        if telemetry.is_enabled():
+            telemetry.emit(
+                "rollout_submit", trace_id=req.trace_id, rid=req.rid,
+                group_id=req.group_id, input_len=len(req.input_ids),
+                server=addr,
+            )
+        attempt = 0
         start = time.perf_counter()
         out_tokens: List[int] = []
         out_logprobs: List[float] = []
@@ -309,6 +318,15 @@ class RemoteInfEngine(InferenceEngine):
                 # back off while the client is paused for a weight update
                 while self.executor.is_paused():
                     await asyncio.sleep(0.25)
+                attempt += 1
+                if attempt > 1 and telemetry.is_enabled():
+                    # resuming after a server-side interrupt: accumulated
+                    # tokens travel back as the new prompt
+                    telemetry.emit(
+                        "resume", trace_id=req.trace_id, attempt=attempt,
+                        generated=len(out_tokens),
+                        prompt_len=len(req.input_ids),
+                    )
                 http_req = self.backend.build_generation_request(req)
                 with self._lock:
                     self._inflight[addr] = self._inflight.get(addr, 0) + 1
@@ -342,6 +360,13 @@ class RemoteInfEngine(InferenceEngine):
                 )
         if stop_reason == "abort" or stop_reason == "interrupt":
             stop_reason = "length"  # exited loop on budget during interruption
+        if telemetry.is_enabled():
+            telemetry.emit(
+                "gen_done", trace_id=req.trace_id,
+                stop_reason=stop_reason or "length",
+                output_len=len(out_tokens), attempts=attempt,
+                latency_s=time.perf_counter() - start,
+            )
         return ModelResponse(
             input_tokens=req.input_ids[:input_len],
             output_tokens=out_tokens,

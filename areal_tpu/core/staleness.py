@@ -61,3 +61,14 @@ class StalenessManager:
     def get_stats(self) -> RolloutStat:
         with self._lock:
             return RolloutStat(**asdict(self._stat))
+
+    def register_metrics(self, reg=None) -> None:
+        """Expose submitted/accepted/running as scrape-time gauges.
+
+        Collectors run only at scrape, so the lock in get_stats is never
+        taken on the rollout hot path.  Defaults to the canonical GEN
+        registry so the gauges ride the generation-side /metrics surface.
+        """
+        from areal_tpu.utils import telemetry
+
+        telemetry.register_staleness(reg or telemetry.GEN, self)

@@ -37,6 +37,8 @@ class JaxBackend:
             # group affinity + fan-out clustering hints (gen/engine.py)
             "group_id": req.group_id,
             "group_n": req.group_n,
+            # trajectory-lifecycle trace id (utils/telemetry.py)
+            "trace_id": req.trace_id or req.rid,
             "input_ids": list(req.input_ids),
             "sampling_params": {
                 "max_new_tokens": g.max_new_tokens,

@@ -59,7 +59,7 @@ from areal_tpu.parallel import (
     mesh_from_alloc,
     shard_pytree,
 )
-from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils import logging, name_resolve, names, telemetry
 from areal_tpu.utils import stats as tracker
 from areal_tpu.utils.data import (
     RowPackedBatch,
@@ -535,6 +535,43 @@ class JaxTrainEngine(TrainEngine):
                 jnp.int32(self.step_count),
             ).compile()
 
+    def _consume_telemetry(
+        self, input_: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        """Strip telemetry-only keys and record consumption evidence.
+
+        `trace_keys` must never reach _prepare_rows: train_batch devices
+        the WHOLE prepared batch (there is no FORWARD_KEYS filter on this
+        path), so an extra key would mint a new XLA signature per run
+        mode.  Staleness-at-consumption = trainer's current version minus
+        each row's max behavior version (per-token `versions`, -1 =
+        prompt) — the paper's bounded-staleness evidence, observed here
+        at the exact consumption point."""
+        keys = input_.get("trace_keys")
+        if keys is not None:
+            input_ = {k: v for k, v in input_.items() if k != "trace_keys"}
+        if not telemetry.is_enabled():
+            return input_
+        versions = np.asarray(input_.get("versions", ()))
+        if versions.ndim != 2:
+            return input_
+        behavior = np.where(versions >= 0, versions, -1).max(axis=-1)
+        tks = None if keys is None else np.asarray(keys).reshape(-1).tolist()
+        consumed = self._version
+        for i, bv in enumerate(behavior.tolist()):
+            if bv < 0:
+                continue
+            staleness = max(0, consumed - int(bv))
+            telemetry.STALENESS_AT_CONSUMPTION.observe(staleness)
+            telemetry.emit(
+                "train_consume",
+                trace_key=(tks[i] if tks is not None and i < len(tks) else None),
+                behavior_version=int(bv),
+                consumed_version=consumed,
+                staleness=staleness,
+            )
+        return input_
+
     def train_batch(
         self,
         input_: Dict[str, np.ndarray],
@@ -542,6 +579,7 @@ class JaxTrainEngine(TrainEngine):
         loss_weight_fn: Callable,
     ) -> Dict[str, float]:
         assert self.initialized and self._optimizer is not None
+        input_ = self._consume_telemetry(input_)
         n_mbs = max(1, self.config.mb_spec.n_mbs)
         rp, data, row_len = self._prepare_rows(input_, n_mbs)
         total_weight = float(loss_weight_fn(data))
@@ -580,9 +618,13 @@ class JaxTrainEngine(TrainEngine):
                     for k, v in distributed.fetch_replicated(tree).items()
                 },
             )
-            return pending.then(
-                lambda st: {**st, "total_loss_weight": total_weight}
-            )
+            def _finish(st: Dict[str, float]) -> Dict[str, float]:
+                st = {**st, "total_loss_weight": total_weight}
+                if telemetry.is_enabled():
+                    telemetry.publish_train_stats(st)
+                return st
+
+            return pending.then(_finish)
         # ONE host transfer for every stat; per-scalar float() would pay a
         # device round-trip each.  Stats are replicated reductions, so each
         # process reads its own full replica.
@@ -610,6 +652,8 @@ class JaxTrainEngine(TrainEngine):
         m = mfu(tps, self.model_config, mean_seg, n_chips=n_chips)
         if m is not None:
             stats["mfu"] = m
+        if telemetry.is_enabled():
+            telemetry.publish_train_stats(stats)
         return stats
 
     def eval_batch(

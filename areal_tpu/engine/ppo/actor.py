@@ -230,6 +230,11 @@ class PPOActor:
 
                 batch = select_rows(batch, keep)
 
+        # consumption evidence must be taken HERE, on the post-filter batch:
+        # the LOSS_KEYS view below drops `versions`/`trace_keys`, so the
+        # engine-level hook inside train_batch never sees them on this path
+        if hasattr(self.engine, "_consume_telemetry"):
+            batch = self.engine._consume_telemetry(batch)
         train_view = {k: batch[k] for k in self.LOSS_KEYS if k in batch}
         mbs = split_padded_tensor_dict_into_mb_list(
             train_view, n_mbs=cfg.ppo_n_minibatches

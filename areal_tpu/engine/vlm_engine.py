@@ -293,6 +293,10 @@ class VLMPPOActor:
         from areal_tpu.utils.data import select_rows_vision
 
         cfg = self.config
+        # same consumption-evidence point as PPOActor.ppo_update: the keyed
+        # view below drops `versions`/`trace_keys`
+        if hasattr(self.engine, "_consume_telemetry"):
+            batch = self.engine._consume_telemetry(batch)
         keys = self._ppo.LOSS_KEYS + VISION_KEYS + (
             "mrope_positions", "patches_per_row",
         )

@@ -114,7 +114,7 @@ from areal_tpu.models.transformer import (
 )
 from areal_tpu.models.hf import load_hf_params
 from areal_tpu.parallel import build_mesh, shard_pytree
-from areal_tpu.utils import logging
+from areal_tpu.utils import logging, telemetry
 from areal_tpu.utils.datapack import round_up_to_bucket
 
 logger = logging.getLogger("gen.engine")
@@ -181,6 +181,10 @@ class GenRequest:
     # within one engine's cache)
     group_id: str = ""
     group_n: int = 0
+    # telemetry (utils/telemetry.py): trajectory trace id carried from the
+    # wire + the submit() timestamp backing the admission-wait histogram
+    trace_id: str = ""
+    submit_ts: float = 0.0
     # filled by the engine
     output_tokens: List[int] = field(default_factory=list)
     output_logprobs: List[float] = field(default_factory=list)
@@ -348,6 +352,10 @@ class GenEngine:
         self.version = 0
         self._standby = None  # (sharded tree, version) pre-staged weights
         self.last_pause_s = 0.0  # achieved generation-idle window
+        # >0 while inside a compound pause entry point (load_weights /
+        # commit_staged): the nested swap tail must not double-record its
+        # sub-window into the pause histogram
+        self._pause_depth = 0
 
         # host-side slot state (scratch slot included, never assigned)
         S = n_slots + 1
@@ -637,6 +645,9 @@ class GenEngine:
         if len(req.input_ids) + 1 >= self.max_seq_len:
             req.finish("length")
             return
+        # one clock read per request: backs the admission-queue-wait
+        # histogram without any conditional on the hot submit path
+        req.submit_ts = time.perf_counter()
         self.pending.put(req)
 
     def submit_batch(self, reqs: List[GenRequest]) -> None:
@@ -665,11 +676,13 @@ class GenEngine:
         slot to a fresh prompt first would overwrite the retained prefix
         exactly when it is most valuable (the r4 abort-storm thrash)."""
         deadline = time.monotonic() + self.abort_reserve_s
+        version_before = self.version
         # finish() runs user on_done callbacks and wakes waiters; calling
         # it under _lock deadlocks any callback that re-enters the engine
         # (areal-lint C5 blocking-under-lock) — collect under the lock,
         # call after release
         to_finish: List[GenRequest] = []
+        n_in_slot = 0
         with self._lock:
             self._abort_gen += 1  # a racing _admit must drop its leftovers
             for s, req in enumerate(self.slot_req):
@@ -693,6 +706,7 @@ class GenEngine:
                     ):
                         self._reserved_until[s] = deadline
             self._state_dirty = True
+            n_in_slot = len(to_finish)
             to_finish.extend(self._holdback)
             self._holdback = []
             while True:
@@ -700,6 +714,16 @@ class GenEngine:
                     to_finish.append(self.pending.get_nowait())
                 except queue.Empty:
                     break
+        if telemetry.is_enabled():
+            # only slot-holding requests were mid-decode: those are the
+            # interrupt spans the resume events pair with (queued/held-back
+            # requests just bounce through the client's resubmit loop)
+            for req in to_finish[:n_in_slot]:
+                telemetry.emit(
+                    "interrupt", trace_id=req.trace_id or req.rid,
+                    reason=reason, version_before=version_before,
+                    generated=len(req.output_tokens),
+                )
         for req in to_finish:
             req.finish(reason)
         return len(to_finish)
@@ -711,23 +735,29 @@ class GenEngine:
         generation: clients resubmit and the new prefill recomputes under the
         new policy). Returns the new version."""
         t0 = time.perf_counter()
-        aborted = self.abort_all("abort")
-        if aborted:
-            logger.info(f"aborted {aborted} requests for weight update")
-        if params is None:
-            assert path is not None
-            path, dir_version = self._resolve_ckpt_dir(path)
-            if version is None:
-                # adopt the trainer's version from the v{N} dir name — a
-                # fresh server must not restart its version counter at 1
-                # while the trainer is at N (staleness gates compare them)
-                version = dir_version
-            params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
-        self.swap_weights_live(params, version=version)
+        version_before = self.version
+        self._pause_depth += 1
+        try:
+            aborted = self.abort_all("abort")
+            if aborted:
+                logger.info(f"aborted {aborted} requests for weight update")
+            if params is None:
+                assert path is not None
+                path, dir_version = self._resolve_ckpt_dir(path)
+                if version is None:
+                    # adopt the trainer's version from the v{N} dir name — a
+                    # fresh server must not restart its version counter at 1
+                    # while the trainer is at N (staleness gates compare them)
+                    version = dir_version
+                params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
+            self.swap_weights_live(params, version=version)
+        finally:
+            self._pause_depth -= 1
         # achieved generation-idle window for the unstaged ABORT path spans
         # the abort + checkpoint load + host->device placement, not just the
         # swap tail (staged swaps record theirs in commit_staged)
         self.last_pause_s = time.perf_counter() - t0
+        self._record_pause(self.last_pause_s, "reload_abort", version_before)
         return self.version
 
     def swap_weights_live(self, params, version: Optional[int] = None) -> int:
@@ -757,6 +787,7 @@ class GenEngine:
             params = dict(params)
             params["vision"] = self.params["vision"]
         t0 = time.perf_counter()
+        version_before = self.version
         self.params = shard_pytree(self.mesh, params, self._pspecs)
         self.version = version if version is not None else self.version + 1
         if not self.retain_kv_on_reload:
@@ -787,6 +818,10 @@ class GenEngine:
             # a STRICTLY NEWER standby (e.g. v6 staged via prepare while a
             # v5 disk publish lands) stays valid for its pending commit
         self.last_pause_s = time.perf_counter() - t0
+        if self._pause_depth == 0:
+            # top-level live publish; nested calls (load_weights /
+            # commit_staged) record their full window themselves
+            self._record_pause(self.last_pause_s, "swap_live", version_before)
         return self.version
 
     def stage_params(self, params, version: Optional[int] = None) -> bool:
@@ -831,19 +866,43 @@ class GenEngine:
         if getattr(self, "_standby", None) is None:
             raise RuntimeError("commit_staged without stage_params")
         t0 = time.perf_counter()
-        if not live:
-            aborted = self.abort_all("abort")
-            if aborted:
-                logger.info(
-                    f"aborted {aborted} requests for staged weight swap"
-                )
-        standby, version = self._standby
-        self._standby = None
-        # shared swap tail (device_put of the already-sharded standby under
-        # the same spec is a no-op, so this stays a pointer swap)
-        self.swap_weights_live(standby, version=version)
+        version_before = self.version
+        self._pause_depth += 1
+        try:
+            if not live:
+                aborted = self.abort_all("abort")
+                if aborted:
+                    logger.info(
+                        f"aborted {aborted} requests for staged weight swap"
+                    )
+            standby, version = self._standby
+            self._standby = None
+            # shared swap tail (device_put of the already-sharded standby
+            # under the same spec is a no-op, so this stays a pointer swap)
+            self.swap_weights_live(standby, version=version)
+        finally:
+            self._pause_depth -= 1
         self.last_pause_s = time.perf_counter() - t0
+        self._record_pause(
+            self.last_pause_s,
+            "commit_live" if live else "commit_abort",
+            version_before,
+        )
         return self.version
+
+    def _record_pause(
+        self, dur: float, kind: str, version_before: int
+    ) -> None:
+        """Every weight-publish pause window lands in the evidence
+        histogram (cold path — the swap itself dwarfs the observe); the
+        event stream additionally records the version transition when
+        telemetry is on."""
+        telemetry.PAUSE_WINDOW.observe(dur)
+        if telemetry.is_enabled():
+            telemetry.emit(
+                "pause", kind=kind, dur_s=dur,
+                version_before=version_before, version_after=self.version,
+            )
 
     def release_memory(self, drop_params: bool = True) -> None:
         """Colocated time-share (alloc `a|b`, VERDICT r3 weak #4): free the
@@ -1292,6 +1351,18 @@ class GenEngine:
             ] + group_deadlines
             self._parked_free = frozenset(free)
             self._parked_until = min(expiries) if expiries else now + 0.05
+        if telemetry.is_enabled():
+            # emitted before the prefill dispatches so the admission event
+            # always precedes the request's first decode/finish in the log
+            now_pc = time.perf_counter()
+            for s, req in admitted:
+                self._emit_admission(req, s, "fresh", 0, now_pc)
+            for s, req in vlm_admitted:
+                self._emit_admission(req, s, "vlm", 0, now_pc)
+            for s, req, start, _, shared in reuse_admitted + shared_admitted:
+                self._emit_admission(
+                    req, s, "shared" if shared else "reuse", start, now_pc
+                )
         if vlm_admitted:
             self._admit_vlm_batch(vlm_admitted)
         if admitted:
@@ -1303,6 +1374,29 @@ class GenEngine:
             # shares were capped at their already-valid lcp), so the fused
             # fan-out copy inside the program reads only settled K/V
             self._admit_suffix_batch(reuse_admitted + shared_admitted)
+
+    def _emit_admission(
+        self, req: GenRequest, slot: int, kind: str, inherited: int,
+        now_pc: float,
+    ) -> None:
+        """Admission + prefill lifecycle events for one admitted request:
+        queue wait (submit -> slot grant, covering holdback/group-hold)
+        and the cold/inherited prefill token split (`kind` says whether
+        the inherited span came from a retained prefix or a fan-out
+        share).  Only called when telemetry is enabled."""
+        wait = max(0.0, now_pc - req.submit_ts) if req.submit_ts else 0.0
+        telemetry.ADMISSION_WAIT.observe(wait)
+        tid = req.trace_id or req.rid
+        telemetry.emit(
+            "admission", trace_id=tid, kind=kind, slot=int(slot),
+            tier=int(self.slot_tier[slot]), queue_wait_s=wait,
+        )
+        total = len(req.input_ids)
+        telemetry.emit(
+            "prefill", trace_id=tid, kind=kind, total_tokens=total,
+            inherited_tokens=int(inherited),
+            cold_tokens=total - int(inherited),
+        )
 
     def _admit_fresh_batch(self, admitted: List[tuple]) -> None:
         """Full prefill for prompts with no reusable prefix anywhere: ONE
@@ -1821,6 +1915,21 @@ class GenEngine:
         for s in active:
             tier_active[int(self.slot_tier[s])].append(s)
         M = self.max_seq_len
+        # decode-chunk telemetry is the one per-dispatch cost, so the whole
+        # block (clock reads, trace-id snapshot) is gated on the flag
+        tele = telemetry.is_enabled()
+        if tele:
+            tier_trace = {
+                t: [
+                    (r.trace_id or r.rid)
+                    for s in tier_active[t]
+                    for r in (self.slot_req[s],)
+                    if r is not None
+                ]
+                for t in range(self.n_tiers)
+                if tier_active[t]
+            }
+            t_dispatch = time.perf_counter()
         dev_outs: List[tuple] = []  # (tier, device out) — fetch after all dispatch
         try:
             for t in range(self.n_tiers):
@@ -1873,6 +1982,17 @@ class GenEngine:
             lo = self.tier_start[t]
             toks[:, lo : lo + self.tier_size[t]] = arr[0].astype(np.int32)
             logps[:, lo : lo + self.tier_size[t]] = arr[1]
+            if tele:
+                lat = time.perf_counter() - t_dispatch
+                telemetry.DECODE_CHUNK.observe(lat, tier=str(t))
+                telemetry.emit(
+                    "decode_chunk",
+                    tier=t,
+                    chunk=n,
+                    n_active=len(tier_active[t]),
+                    latency_s=lat,
+                    trace_ids=tier_trace.get(t, []),
+                )
 
         delivered = 0
         to_finish: List[tuple] = []

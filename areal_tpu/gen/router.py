@@ -42,7 +42,7 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.analysis.lockcheck import lock_guarded
-from areal_tpu.utils import logging, name_resolve, names, network
+from areal_tpu.utils import logging, name_resolve, names, network, telemetry
 
 logger = logging.getLogger("gen.router")
 
@@ -300,20 +300,54 @@ class Router:
         )
 
     async def metrics(self, request: web.Request) -> web.Response:
+        # ledger fields are lock-guarded (C1), so the Prometheus path must
+        # snapshot inside the handler under _lock — NOT via a sync scrape-time
+        # collector that would read _running/_accepted without the lock
         async with self._lock:
             cap = self._capacity()
-            return web.json_response(
-                {
-                    "version": self.version,
-                    "inflight": dict(self._inflight),
-                    "requests_routed": dict(self._routed),
-                    "tokens_inflight": dict(self._tokens),
-                    "running": len(self._running),
-                    "accepted": self._accepted,
-                    "capacity": cap,
-                    "n_flushes": self.n_flushes,
-                }
+            snap = {
+                "version": self.version,
+                "inflight": dict(self._inflight),
+                "requests_routed": dict(self._routed),
+                "tokens_inflight": dict(self._tokens),
+                "running": len(self._running),
+                "accepted": self._accepted,
+                "capacity": cap,
+                "n_flushes": self.n_flushes,
+            }
+        if telemetry.wants_prometheus(
+            request.query.get("format"), request.headers.get("Accept", "")
+        ):
+            reg = telemetry.ROUTER
+            reg.gauge("weight_version", "fleet weight version").set(snap["version"])
+            reg.gauge("rollout_running", "leased rollout allocations").set(
+                snap["running"]
             )
+            reg.counter("rollout_accepted_total", "accepted rollouts").set_total(
+                snap["accepted"]
+            )
+            reg.gauge(
+                "admission_capacity", "remaining staleness-gate admissions"
+            ).set(-1 if cap is None else cap)
+            reg.counter("flushes_total", "fleet weight flushes").set_total(
+                snap["n_flushes"]
+            )
+            for addr, v in snap["requests_routed"].items():
+                reg.counter("requests_routed_total", "requests per backend").set_total(
+                    v, server=addr
+                )
+            for addr, v in snap["inflight"].items():
+                reg.gauge("requests_inflight", "in-flight per backend").set(
+                    v, server=addr
+                )
+            for addr, v in snap["tokens_inflight"].items():
+                reg.gauge("tokens_inflight", "in-flight tokens per backend").set(
+                    v, server=addr
+                )
+            return web.Response(
+                text=reg.render_prometheus(), content_type="text/plain"
+            )
+        return web.json_response(snap)
 
     # ------------------------ flush + update ----------------------------
 

@@ -26,6 +26,7 @@ import asyncio
 import base64
 import threading
 import time
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -35,7 +36,7 @@ from aiohttp import web
 from areal_tpu.analysis.lockcheck import lock_guarded
 from areal_tpu.gen.engine import GenEngine, GenRequest
 from areal_tpu.models.model_config import TransformerConfig, tiny_config
-from areal_tpu.utils import logging, name_resolve, names, network
+from areal_tpu.utils import logging, name_resolve, names, network, telemetry
 
 logger = logging.getLogger("gen.server")
 
@@ -61,6 +62,57 @@ class GenServer:
         self.step_count = 0
         self.tokens_out = 0
         self.last_error: float = 0.0
+        self._register_telemetry()
+
+    def _register_telemetry(self):
+        """Scrape-time collector mirroring engine/server counters into the
+        shared `gen` registry (utils/telemetry.py).  Sampling happens only
+        when /metrics is rendered — the decode loop never touches it.  The
+        collector holds a weakref so short-lived servers (tests, benches)
+        don't pin their engines through the process-global registry."""
+        reg = telemetry.GEN
+        self_ref = weakref.ref(self)
+
+        def _collect():
+            srv = self_ref()
+            if srv is None:
+                return
+            eng = srv.engine
+            # every engine.stats entry is a monotonic counter; mirroring the
+            # dict generically keeps the exposition tolerant of key churn
+            for k, v in eng.stats.items():
+                try:
+                    reg.counter(f"{k}_total").set_total(float(v))
+                except (TypeError, ValueError):
+                    continue
+            reg.counter(
+                "decode_steps_total", "Productive decode-loop steps"
+            ).set_total(srv.step_count)
+            reg.counter(
+                "tokens_generated_total", "Decode tokens delivered"
+            ).set_total(srv.tokens_out)
+            reg.gauge("active_requests", "Occupied slots").set(
+                eng.active_count()
+            )
+            reg.gauge("weight_version", "Live weight version").set(eng.version)
+            reg.gauge(
+                "last_pause_seconds",
+                "Most recent weight-swap pause window (histogram: "
+                "areal_gen_pause_window_seconds)",
+            ).set(eng.last_pause_s)
+            reg.gauge("staged_standby", "Standby weights staged (0/1)").set(
+                1.0 if eng.has_standby else 0.0
+            )
+            reg.gauge(
+                "decode_attended_fraction",
+                "Attended / ceiling decode columns",
+            ).set(eng.decode_attended_fraction())
+            for t, occ in enumerate(eng.tier_occupancy()):
+                reg.gauge(
+                    "tier_occupancy", "Occupied slots per decode tier"
+                ).set(occ, tier=str(t))
+
+        reg.add_collector(_collect)
 
     # ------------------------------ worker ------------------------------
 
@@ -134,6 +186,7 @@ class GenServer:
             image_grid_thw = np.asarray(body["image_grid_thw"], np.int64)
         return GenRequest(
             rid=body.get("rid", ""),
+            trace_id=str(body.get("trace_id", "") or ""),
             group_id=str(body.get("group_id", "") or ""),
             group_n=int(body.get("group_n", 0) or 0),
             input_ids=[int(t) for t in body["input_ids"]],
@@ -156,6 +209,7 @@ class GenServer:
             "output_versions": r.output_versions,
             "stop_reason": r.stop_reason or "stop",
             "version": version,
+            "trace_id": r.trace_id,
         }
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -374,6 +428,19 @@ class GenServer:
         )
 
     async def metrics(self, request: web.Request) -> web.Response:
+        # Prometheus text exposition on request (?format=prometheus or an
+        # Accept header asking for text/openmetrics); legacy JSON stays the
+        # default for existing consumers
+        if telemetry.wants_prometheus(
+            request.query.get("format"), request.headers.get("Accept", "")
+        ):
+            return web.Response(
+                text=telemetry.GEN.render_prometheus(),
+                content_type="text/plain",
+            )
+        # engine.stats lookups go through .get so a stats-key rename degrades
+        # a counter to 0 instead of 500ing the whole scrape
+        stats = self.engine.stats
         return web.json_response(
             {
                 "decode_steps": self.step_count,
@@ -385,19 +452,17 @@ class GenServer:
                 "staged": self.engine.has_standby,
                 # prefill-side token accounting: cold vs retained-reuse vs
                 # group fan-out (shared) — the grouped-prefill savings
-                "prefill_tokens": self.engine.stats["prefill_tokens"],
-                "suffix_tokens": self.engine.stats["suffix_tokens"],
-                "reused_tokens": self.engine.stats["reused_tokens"],
-                "shared_tokens": self.engine.stats["shared_tokens"],
-                "copy_calls": self.engine.stats["copy_calls"],
+                "prefill_tokens": stats.get("prefill_tokens", 0),
+                "suffix_tokens": stats.get("suffix_tokens", 0),
+                "reused_tokens": stats.get("reused_tokens", 0),
+                "shared_tokens": stats.get("shared_tokens", 0),
+                "copy_calls": stats.get("copy_calls", 0),
                 # abort-reservation TTL observability (VERDICT r6 #10):
                 # reservations that expired unclaimed — nonzero means
                 # aborted clients are not resubmitting within
                 # abort_reserve_s and the retained-prefix handoff is
                 # silently degrading to fresh prefills
-                "reservations_lapsed": self.engine.stats[
-                    "reservations_lapsed"
-                ],
+                "reservations_lapsed": stats.get("reservations_lapsed", 0),
                 # tiered decode (ISSUE 5): attended span / configured
                 # ceiling over all decode dispatches (1.0 = paying the
                 # full max_seq_len width), per-cohort occupancy, and
@@ -408,7 +473,7 @@ class GenServer:
                 "tier_occupancy": self.engine.tier_occupancy(),
                 "tier_slots": list(self.engine.tier_size),
                 "tier_lens": list(self.engine.tier_bounds),
-                "tier_migrations": self.engine.stats["tier_migrations"],
+                "tier_migrations": stats.get("tier_migrations", 0),
             }
         )
 
@@ -483,7 +548,12 @@ def main():
     p.add_argument("--decode-tier-slots", default="",
                    help="explicit per-tier slot counts (comma list, must "
                         "sum to --n-slots)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable trajectory-lifecycle event emission "
+                        "(utils/telemetry.py; also via AREAL_TELEMETRY=1)")
     args = p.parse_args()
+    if args.telemetry:
+        telemetry.set_enabled(True)
     tier_kw = dict(
         decode_window=not args.no_decode_window,
         decode_tiers=args.decode_tiers,

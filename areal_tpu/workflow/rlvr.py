@@ -18,7 +18,7 @@ from areal_tpu.api.config import GenerationHyperparameters
 from areal_tpu.api.io_struct import ModelRequest
 from areal_tpu.api.reward import AsyncRewardWrapper
 from areal_tpu.api.workflow import RolloutWorkflow
-from areal_tpu.utils import logging
+from areal_tpu.utils import logging, telemetry
 from areal_tpu.utils.data import pad_sequences_to_tensors
 
 logger = logging.getLogger("rlvr")
@@ -91,11 +91,15 @@ class RLVRWorkflow(RolloutWorkflow):
                 r.rid = f"{req.rid}-{k}"
                 r.group_id = req.rid
                 r.group_n = n
+        # pin the lifecycle trace id here (not in agenerate) so reward and
+        # trainer-consumption events can be joined to generation-side spans
+        for r in reqs:
+            r.trace_id = r.rid
         resps = await asyncio.gather(
             *[engine.agenerate(r) for r in reqs]
         )
         results = []
-        for resp in resps:
+        for r, resp in zip(reqs, resps):
             completion_str = (
                 self.tokenizer.decode(resp.output_tokens)
                 if self.tokenizer is not None
@@ -124,6 +128,22 @@ class RLVRWorkflow(RolloutWorkflow):
                 versions=np.array(versions, dtype=np.int32),
                 rewards=np.float32(reward),
             )
+            if telemetry.is_enabled():
+                out_v = [v for v in resp.output_versions if v >= 0]
+                telemetry.emit(
+                    "reward",
+                    trace_id=r.trace_id,
+                    reward=float(reward),
+                    output_len=resp.output_len,
+                    stop_reason=resp.stop_reason,
+                    version_min=min(out_v) if out_v else -1,
+                    version_max=max(out_v) if out_v else -1,
+                )
+                # 0-d scalar: pad_sequences_to_tensors stacks it to [B], and
+                # the trainer strips it before device transfer (no new XLA
+                # signature); keyed only when enabled so concat across a run
+                # sees a consistent key set
+                result["trace_keys"] = np.int64(telemetry.trace_key(r.trace_id))
             results.append(self._augment_result(result, data, resp))
             if self.dump_dir:
                 self._dump(data, prompt_str, completion_str, reward, resp)

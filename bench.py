@@ -23,9 +23,11 @@ Extra fields (informational): mfu (model-flops 6PT / peak), step_ms,
 tokens_per_step, and a 16k-context variant result when it fits
 (ctx-scaling evidence for the 32k-context workstream).
 
-Env knobs: BENCH_PROFILE=/path -> writes a jax.profiler trace of 2 steps.
+Env knobs: BENCH_PROFILE=/path -> writes a jax.profiler trace of 2 steps
+(equivalent to --xla-profile-dir).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -183,6 +185,19 @@ def _run_on_actor(actor, model_cfg, model_name, n_rows, row_len, seqs_per_row):
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--xla-profile-dir",
+        default=os.environ.get("BENCH_PROFILE", ""),
+        help="write a jax.profiler trace of 2 warm steps here "
+        "(utils/profiling.py profile_trace; BENCH_PROFILE env is the "
+        "legacy spelling)",
+    )
+    args = p.parse_args()
+    if args.xla_profile_dir:
+        # _run_on_actor reads the env knob at its capture point
+        os.environ["BENCH_PROFILE"] = args.xla_profile_dir
+
     from areal_tpu.models.model_config import qwen25_1p5b
 
     # best-throughput workload first (probed on v5e: 8 rows beats 12 —
@@ -254,6 +269,8 @@ def main():
         raise last_err
     result["attempts"] = attempts
     result["lm_head_impl"] = os.environ.get("AREAL_LM_HEAD_IMPL", "fused")
+    if args.xla_profile_dir:
+        result["xla_profile_dir"] = args.xla_profile_dir
 
     # ctx-scaling variant: one 16k-token sequence per row — evidence the
     # splash path holds at long context (no O(T^2) mask materialisation)

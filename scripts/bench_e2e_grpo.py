@@ -429,6 +429,14 @@ def main():
                    help="colocated = in-process ColocatedEngine handoff; "
                         "remote = REAL GenServer over HTTP + RemoteJaxEngine "
                         "+ transfer-mode weight publish (the fleet slice)")
+    p.add_argument("--telemetry-dir", default="",
+                   help="enable unified telemetry (utils/telemetry.py) and "
+                        "dump events.jsonl + trace.json (Perfetto) + "
+                        "metrics.json registry snapshots here; also starts "
+                        "a trainer-side /metrics endpoint")
+    p.add_argument("--xla-profile-dir", default="",
+                   help="wrap the measured mode loop in a jax.profiler "
+                        "trace (utils/profiling.py profile_trace)")
     p.add_argument("--dataset", default="random",
                    choices=["random", "gsm8k-synth"],
                    help="random = synthetic token prompts (throughput "
@@ -454,6 +462,18 @@ def main():
         # the baked TPU plugin forces jax_platforms at interpreter boot;
         # re-apply the env choice so CPU smoke runs stay off the chip
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from areal_tpu.utils import telemetry
+
+    train_metrics_port = None
+    if args.telemetry_dir:
+        # enable BEFORE any engine/workflow is built so lifecycle events
+        # from warmup onward land in the log
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        telemetry.set_enabled(True)
+        _, train_metrics_port = telemetry.start_metrics_server(telemetry.TRAIN)
+        print(f"trainer /metrics on :{train_metrics_port}",
+              file=sys.stderr, flush=True)
 
     from areal_tpu.api.config import GenerationHyperparameters
     from areal_tpu.api.reward import prewarm_reward_pool
@@ -588,19 +608,28 @@ def main():
         "warm_s": warm_s,
     }
     try:
-        for mode in args.modes.split(","):
-            if args.transport == "remote":
-                result[mode] = run_mode_remote(
-                    mode, actor, client, server_engine, meta, workflow,
-                    dataset, args.batch_size, args.steps,
-                    warmup=args.warmup,
-                )
-            else:
-                result[mode] = run_mode(
-                    mode, actor, serving, workflow, dataset,
-                    args.batch_size, args.steps, warmup=args.warmup,
-                    interrupt_publish=interrupt_publish,
-                )
+        from contextlib import nullcontext
+
+        prof_ctx = nullcontext()
+        if args.xla_profile_dir:
+            from areal_tpu.utils.profiling import profile_trace
+
+            prof_ctx = profile_trace(args.xla_profile_dir)
+            result["xla_profile_dir"] = args.xla_profile_dir
+        with prof_ctx:
+            for mode in args.modes.split(","):
+                if args.transport == "remote":
+                    result[mode] = run_mode_remote(
+                        mode, actor, client, server_engine, meta, workflow,
+                        dataset, args.batch_size, args.steps,
+                        warmup=args.warmup,
+                    )
+                else:
+                    result[mode] = run_mode(
+                        mode, actor, serving, workflow, dataset,
+                        args.batch_size, args.steps, warmup=args.warmup,
+                        interrupt_publish=interrupt_publish,
+                    )
         if "sync" in result and "async" in result:
             result["async_over_sync_trajs_per_sec"] = round(
                 result["async"]["trajs_per_sec_per_chip"]
@@ -632,6 +661,27 @@ def main():
                 "shared_fraction": round(
                     st["shared_tokens"] / max(total_prefill, 1), 3
                 ),
+            }
+        if args.telemetry_dir:
+            events_path = os.path.join(args.telemetry_dir, "events.jsonl")
+            trace_path = os.path.join(args.telemetry_dir, "trace.json")
+            snap_path = os.path.join(args.telemetry_dir, "metrics.json")
+            n_events = telemetry.EVENTS.dump_jsonl(events_path)
+            telemetry.EVENTS.dump_chrome_trace(trace_path)
+            with open(snap_path, "w") as f:
+                json.dump({
+                    "gen": telemetry.GEN.snapshot(),
+                    "train": telemetry.TRAIN.snapshot(),
+                    "router": telemetry.ROUTER.snapshot(),
+                }, f, indent=2, default=str)
+            result["telemetry"] = {
+                "dir": args.telemetry_dir,
+                "events_jsonl": events_path,
+                "chrome_trace": trace_path,
+                "metrics_snapshot": snap_path,
+                "n_events": n_events,
+                "dropped_events": telemetry.EVENTS.dropped,
+                "trainer_metrics_port": train_metrics_port,
             }
         # the result line must survive teardown hiccups (stale request
         # callbacks etc.) — print FIRST, clean up after

@@ -398,7 +398,16 @@ def main():
     p.add_argument("--model", default="qwen25_1p5b",
                    choices=["qwen25_1p5b", "tiny"],
                    help="tiny = CPU smoke mode (token accounting only)")
+    p.add_argument("--telemetry-dir", default="",
+                   help="enable unified telemetry (utils/telemetry.py) and "
+                        "dump events.jsonl + the gen registry snapshot here")
     args = p.parse_args()
+
+    from areal_tpu.utils import telemetry
+
+    if args.telemetry_dir:
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        telemetry.set_enabled(True)
 
     import jax
 
@@ -432,6 +441,19 @@ def main():
             prompt_len=args.ab_prompt, gen_tokens=args.ab_gen,
             tiers=args.ab_tiers, window=not args.no_decode_window,
         )
+    if args.telemetry_dir:
+        events_path = os.path.join(args.telemetry_dir, "events.jsonl")
+        snap_path = os.path.join(args.telemetry_dir, "metrics.json")
+        n_events = telemetry.EVENTS.dump_jsonl(events_path)
+        with open(snap_path, "w") as f:
+            json.dump({"gen": telemetry.GEN.snapshot()}, f, indent=2,
+                      default=str)
+        result["telemetry"] = {
+            "dir": args.telemetry_dir,
+            "events_jsonl": events_path,
+            "metrics_snapshot": snap_path,
+            "n_events": n_events,
+        }
     print(json.dumps(result))
 
 

@@ -67,9 +67,19 @@ def test_remote_live_publish_smoke():
     assert out["shared_prefill"]["shared_tokens"] > 0
 
 
-def test_remote_abort_publish_gsm8k_synth_smoke():
+@pytest.fixture(scope="module")
+def abort_run(tmp_path_factory):
+    """One abort-mode bench run shared by the smoke + lifecycle tests
+    (the subprocess is the expensive part; --telemetry-dir rides along)."""
+    tdir = tmp_path_factory.mktemp("telemetry")
     out = _run_bench(["--publish-mode", "abort",
-                      "--dataset", "gsm8k-synth"])
+                      "--dataset", "gsm8k-synth",
+                      "--telemetry-dir", str(tdir)])
+    return out, tdir
+
+
+def test_remote_abort_publish_gsm8k_synth_smoke(abort_run):
+    out, _ = abort_run
     assert out["publish_mode"] == "abort"
     assert out["dataset"] == "gsm8k-synth"
     a = out["async"]
@@ -77,3 +87,76 @@ def test_remote_abort_publish_gsm8k_synth_smoke():
     # the real math reward ran (a from-scratch tiny model scores ~0, but
     # the field must exist and be a finite fraction)
     assert 0.0 <= a["reward_mean"] <= 1.0
+
+
+def test_trajectory_lifecycle_reconstructs_from_jsonl(abort_run):
+    """ISSUE 10 acceptance: one full trajectory lifecycle — submit ->
+    admission -> prefill -> decode -> (interrupt -> resume at the abort
+    publish) -> reward -> trainer consumption with staleness — must be
+    reconstructable purely from the JSONL event log."""
+    out, tdir = abort_run
+    tele = out["telemetry"]
+    assert tele["n_events"] > 0
+    events_path = tele["events_jsonl"]
+    assert os.path.exists(events_path)
+    with open(events_path) as f:
+        evs = [json.loads(line) for line in f]
+    assert len(evs) == tele["n_events"]
+
+    by_trace = {}
+    for e in evs:
+        if "trace_id" in e:
+            by_trace.setdefault(e["trace_id"], []).append(e)
+    consumed = {e["trace_key"]: e for e in evs
+                if e["event"] == "train_consume"
+                and e.get("trace_key") is not None}
+
+    # at least one trajectory shows the FULL chain, in timestamp order,
+    # ending in a trainer consumption joined via trace_key
+    full = []
+    for tid, tes in by_trace.items():
+        names = [e["event"] for e in tes]
+        if not {"rollout_submit", "admission", "prefill", "gen_done",
+                "reward"} <= set(names):
+            continue
+        order = [names.index(n) for n in
+                 ("rollout_submit", "admission", "prefill", "gen_done",
+                  "reward")]
+        assert order == sorted(order), (tid, names)
+        tk = tes[0]["trace_key"]
+        if tk in consumed:
+            full.append((tid, tes, consumed[tk]))
+    assert full, "no trajectory with a complete, trainer-joined lifecycle"
+    tid, tes, tc = full[0]
+    # prefill token split is self-consistent
+    pf = next(e for e in tes if e["event"] == "prefill")
+    assert pf["cold_tokens"] + pf["inherited_tokens"] == pf["total_tokens"]
+    # consumption evidence carries the staleness measurement
+    assert tc["staleness"] >= 0
+    assert tc["consumed_version"] >= tc["behavior_version"]
+    # decode made progress on some traced request (chunk events carry the
+    # per-tier active trace-id lists)
+    chunks = [e for e in evs if e["event"] == "decode_chunk"]
+    traced_in_chunks = {t for e in chunks for t in e.get("trace_ids", ())}
+    assert traced_in_chunks & set(by_trace)
+
+    # abort-mode publishes interrupt in-flight requests; every interrupted
+    # trace must show a later resume or re-admission (the pause/interrupt
+    # evidence ROADMAP item 4 asks for)
+    interrupted = {t: es for t, es in by_trace.items()
+                   if any(e["event"] == "interrupt" for e in es)}
+    assert interrupted, "abort publish produced no interrupt spans"
+    for t, es in interrupted.items():
+        it = min(e["ts"] for e in es if e["event"] == "interrupt")
+        assert any(e["ts"] >= it and e["event"] in ("resume", "admission")
+                   for e in es), t
+
+    # sidecar artifacts: Chrome trace + metrics snapshot with the two
+    # evidence histograms populated
+    trace = json.load(open(tele["chrome_trace"]))
+    phases = {e["ph"] for e in trace["traceEvents"]}
+    assert "X" in phases and "i" in phases
+    metrics = json.load(open(tele["metrics_snapshot"]))
+    assert metrics["gen"]["areal_gen_pause_window_seconds_count"]["_"] >= 1
+    assert (metrics["train"]
+            ["areal_train_staleness_at_consumption_count"]["_"] >= 1)
