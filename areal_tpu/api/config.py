@@ -224,6 +224,12 @@ class InferenceEngineConfig:
     request_retries: int = 3
     pause_grace_period: float = 0.0
     cleanup_timeout: float = 120.0
+    # trajectory failover (ISSUE 11): how many times one trajectory may be
+    # resubmitted to a different server after a backend failure before it
+    # is declared lost, and how long a failed server is excluded from
+    # re-placement
+    failover_retries: int = 3
+    failover_cooldown: float = 30.0
 
 
 @dataclass

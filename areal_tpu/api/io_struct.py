@@ -215,6 +215,11 @@ class RolloutStat:
     submitted: int = 0
     accepted: int = 0
     running: int = 0
+    # rollouts that settled without acceptance (should_accept veto, episode
+    # failure, or trajectory lost to fleet failure) — tracked explicitly so
+    # the ledger invariant submitted == accepted + rejected + running is
+    # checkable at every transition
+    rejected: int = 0
 
 
 @dataclass

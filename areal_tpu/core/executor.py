@@ -28,6 +28,15 @@ from areal_tpu.utils.dataloader import StatefulDataLoader, cycle_dataloader
 logger = logging.getLogger("executor")
 
 
+class TrajectoryLostError(RuntimeError):
+    """A rollout's generation could not be completed on ANY server (the
+    failover budget ran out mid-trajectory).  Unlike an ordinary episode
+    exception — a workflow bug, which stays fatal — a lost trajectory is an
+    expected fleet-failure outcome: the executor settles its staleness
+    accounting (submitted -> rejected), counts it, and the run continues
+    with a reported loss fraction instead of crashing."""
+
+
 def check_trajectory_format(
     traj: Dict[str, Any], expected_keys: Optional[Set[str]] = None
 ):
@@ -81,6 +90,9 @@ class WorkflowExecutor:
         self._pending_results: List[Dict[str, Any]] = []
         self._expected_keys: Optional[Set[str]] = None
         self._data_generator = None
+        # trajectories abandoned after exhausting failover retries; exposed
+        # so benches/e2e report a loss fraction instead of hiding deaths
+        self.lost_trajectories = 0
         # optional fleet-wide admission gate (set by RemoteInfEngine when a
         # router is discovered): with N clients sharing one generation fleet,
         # the local StalenessManager alone would overshoot the global
@@ -124,6 +136,18 @@ class WorkflowExecutor:
                     traj = await ti.workflow.arun_episode(
                         self.inference_engine, ti.data
                     )
+                except TrajectoryLostError as e:
+                    # fleet failure, not a code bug: account the loss
+                    # explicitly (the reject below settles submitted ->
+                    # rejected so capacity never leaks) and keep running
+                    self.lost_trajectories += 1
+                    logger.warning(f"trajectory lost to fleet failure: {e}")
+                    if telemetry.is_enabled():
+                        telemetry.emit(
+                            "trajectory_lost",
+                            lost_total=self.lost_trajectories,
+                        )
+                    traj = None
                 except BaseException:
                     # the submit-side increment must be balanced even on
                     # failure, or every crashed episode permanently eats one

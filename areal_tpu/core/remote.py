@@ -37,9 +37,13 @@ from areal_tpu.api.io_struct import (
     WeightUpdateRequests,
 )
 from areal_tpu.api.workflow import RolloutWorkflow
-from areal_tpu.core.executor import WorkflowExecutor
+from areal_tpu.core.executor import TrajectoryLostError, WorkflowExecutor
 from areal_tpu.utils import logging, name_resolve, names, telemetry
-from areal_tpu.utils.http import arequest_with_retry, get_default_connector
+from areal_tpu.utils.http import (
+    HttpRequestError,
+    arequest_with_retry,
+    get_default_connector,
+)
 
 logger = logging.getLogger("remote_engine")
 
@@ -159,6 +163,7 @@ class RemoteInfEngine(InferenceEngine):
         "_server_idx": "_lock",
         "_rid_to_addr": "_lock",
         "_inflight": "_lock",
+        "_failed": "_lock",
     }
 
     def __init__(self, config: InferenceEngineConfig, backend: RemoteInfBackendProtocol):
@@ -170,6 +175,10 @@ class RemoteInfEngine(InferenceEngine):
         self._lock = threading.Lock()
         self._rid_to_addr: "OrderedDict[str, str]" = OrderedDict()
         self._inflight: Dict[str, int] = {}
+        # failover bookkeeping: addr -> monotonic time of last observed
+        # failure; recently-failed servers are excluded from re-placement
+        # for config.failover_cooldown seconds
+        self._failed: Dict[str, float] = {}
         self.executor = WorkflowExecutor(config, inference_engine=self)
 
     # --- lifecycle / discovery ---
@@ -270,6 +279,37 @@ class RemoteInfEngine(InferenceEngine):
             self._rid_to_addr[rid] = addr
             return addr
 
+    def _failover_server(self, dead: str, key: str) -> str:
+        """Re-place `key` (group id or rid) after `dead` failed mid-request:
+        mark the failure, evict EVERY affinity pinned to the dead server (a
+        GRPO group's siblings all ride the group key, so the whole group
+        reroutes together and fan-out prefix sharing re-forms on the new
+        replica), and pin the key to a server that hasn't failed within the
+        cooldown window.  When everyone is cooling down, place anyway —
+        retrying a maybe-recovered server beats losing the trajectory."""
+        now = time.monotonic()
+        with self._lock:
+            self._failed[dead] = now
+            for r in [r for r, a in self._rid_to_addr.items() if a == dead]:
+                del self._rid_to_addr[r]
+            cooldown = self.config.failover_cooldown
+            pool = [
+                a
+                for a in self.addresses
+                if (t := self._failed.get(a)) is None or now - t > cooldown
+            ] or self.addresses
+            if self.config.schedule_policy == "least_requests":
+                inflight = self._inflight
+                addr = min(pool, key=lambda a: inflight.get(a, 0))
+            else:
+                addr = pool[self._server_idx % len(pool)]
+                self._server_idx += 1
+            if key:
+                if len(self._rid_to_addr) >= RID_CACHE_SIZE:
+                    self._rid_to_addr.popitem(last=False)
+                self._rid_to_addr[key] = addr
+            return addr
+
     # --- generation with interruption loop ---
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         req = req.copy()
@@ -295,6 +335,7 @@ class RemoteInfEngine(InferenceEngine):
                 server=addr,
             )
         attempt = 0
+        failovers = 0
         start = time.perf_counter()
         out_tokens: List[int] = []
         out_logprobs: List[float] = []
@@ -328,9 +369,16 @@ class RemoteInfEngine(InferenceEngine):
                         prompt_len=len(req.input_ids),
                     )
                 http_req = self.backend.build_generation_request(req)
+                next_addr: Optional[str] = None
                 with self._lock:
                     self._inflight[addr] = self._inflight.get(addr, 0) + 1
                 try:
+                    # /generate is NOT idempotent (server-side slot + version
+                    # accounting per call): the retry helper only replays
+                    # never-sent connection failures; everything else raises
+                    # into the failover path below, which resubmits with the
+                    # accumulated tokens — the same resume contract the
+                    # interruption loop already relies on
                     raw = await arequest_with_retry(
                         addr=addr,
                         endpoint=http_req.endpoint,
@@ -339,10 +387,49 @@ class RemoteInfEngine(InferenceEngine):
                         max_retries=self.config.request_retries,
                         timeout=self.config.request_timeout,
                         session=session,
+                        idempotent=False,
                     )
+                except HttpRequestError as e:
+                    failovers += 1
+                    if failovers > self.config.failover_retries:
+                        if telemetry.is_enabled():
+                            telemetry.emit(
+                                "rollout_lost", trace_id=req.trace_id,
+                                rid=req.rid, group_id=req.group_id,
+                                server=addr, generated=len(out_tokens),
+                                failovers=failovers,
+                            )
+                        raise TrajectoryLostError(
+                            f"rid {req.rid}: no healthy server after "
+                            f"{failovers} failovers (last: {e})"
+                        ) from e
+                    next_addr = self._failover_server(
+                        addr, req.group_id or req.rid
+                    )
+                    logger.warning(
+                        f"rid {req.rid}: {addr} failed ({e}); resubmitting "
+                        f"to {next_addr} with {len(out_tokens)} tokens "
+                        f"generated"
+                    )
+                    if telemetry.is_enabled():
+                        # a RESUBMIT span, not a fresh submit: it joins the
+                        # original trace_id so the lifecycle reconstruction
+                        # shows one trajectory surviving a server death
+                        telemetry.emit(
+                            "resubmit", trace_id=req.trace_id, rid=req.rid,
+                            group_id=req.group_id, from_server=addr,
+                            to_server=next_addr, generated=len(out_tokens),
+                            attempt=attempt,
+                        )
+                    telemetry.CLIENT_RESUBMISSIONS.inc()
                 finally:
                     with self._lock:
-                        self._inflight[addr] = self._inflight.get(addr, 1) - 1
+                        self._inflight[addr] = max(
+                            0, self._inflight.get(addr, 1) - 1
+                        )
+                if next_addr is not None:
+                    addr = next_addr
+                    continue
                 result = self.backend.parse_generation_response(raw)
                 stop_reason = result.stop_reason
                 version = (
@@ -391,10 +478,31 @@ class RemoteInfEngine(InferenceEngine):
             )
 
         async def _all():
+            # per-server outcomes: one dead server must not wedge the whole
+            # control-plane action behind its timeout, and the trainer needs
+            # to know who missed the publish (the router's rejoin path
+            # force-reloads them before they serve again)
             reqs = build().requests
-            await asyncio.gather(
-                *[_one(a, r) for a in self.addresses for r in reqs]
+            pairs = [(a, r) for a in self.addresses for r in reqs]
+            results = await asyncio.gather(
+                *[_one(a, r) for a, r in pairs], return_exceptions=True
             )
+            failed = {}
+            for (a, _), res in zip(pairs, results):
+                if isinstance(res, BaseException):
+                    failed[a] = res
+            for a, exc in failed.items():
+                logger.warning(f"control-plane fanout to {a} failed: {exc!r}")
+            if failed:
+                telemetry.TRAIN.counter(
+                    "publish_partial_failures_total",
+                    "servers missed by client control-plane fanouts",
+                ).inc(len(failed))
+            if len(failed) == len(self.addresses):
+                raise RuntimeError(
+                    f"control-plane fanout reached no server: "
+                    f"{sorted(failed)}"
+                )
 
         # run on a private loop in this (caller) thread: pause/update/resume
         # is a blocking control-plane action for the trainer

@@ -44,19 +44,38 @@ class StalenessManager:
             )
             return min(concurrency_cap, staleness_cap)
 
+    def _check_locked(self) -> None:  # holds: _lock
+        """Ledger invariant: every submitted rollout is exactly one of
+        accepted / rejected / still running.  A violation means a death
+        path settled a rollout twice or not at all — the capacity-leak bug
+        class this class exists to prevent — so fail loudly at the
+        transition that broke it, not thousands of steps later as a wedged
+        admission gate."""
+        s = self._stat
+        if s.submitted != s.accepted + s.rejected + s.running or s.running < 0:
+            raise RuntimeError(
+                f"staleness ledger violated: submitted={s.submitted} != "
+                f"accepted={s.accepted} + rejected={s.rejected} + "
+                f"running={s.running}"
+            )
+
     def on_rollout_submitted(self) -> None:
         with self._lock:
             self._stat.submitted += 1
             self._stat.running += 1
+            self._check_locked()
 
     def on_rollout_accepted(self) -> None:
         with self._lock:
             self._stat.accepted += 1
             self._stat.running -= 1
+            self._check_locked()
 
     def on_rollout_rejected(self) -> None:
         with self._lock:
+            self._stat.rejected += 1
             self._stat.running -= 1
+            self._check_locked()
 
     def get_stats(self) -> RolloutStat:
         with self._lock:

@@ -1,0 +1,286 @@
+"""Router-side active health checking with a per-backend circuit breaker.
+
+Replaces the scrape-time-only `/health` fanout: a background probe loop
+feeds per-backend state machines, and placement reads an immutable
+snapshot of who is placeable — so a dead backend is evicted from routing
+within ~`failure_threshold * interval`, not discovered per-request.
+
+State machine per backend::
+
+    closed ──(failure_threshold consecutive failures)──► open
+    open ──(probe succeeds)──► half_open
+    half_open ──(rejoin verify ok)──► closed
+    half_open ──(probe/verify fails)──► open
+    any ──drain()──► draining ──undrain()──► closed
+
+`closed` is the healthy steady state (breaker terminology: requests flow
+through the closed circuit).  `open` backends receive no placements and
+no fanouts.  `half_open` means a probe answered after death — the backend
+is kept out of placement until ``verify_rejoin`` (router-supplied: check
+the served weight version against the fleet's, force-reload if stale)
+passes, so a restarted server can never serve stale weights into a batch.
+`draining` is operator-requested graceful removal: no NEW placements, but
+the backend still counts as alive for fanouts so in-flight work and the
+final weight sync complete.
+
+Locking: `_states` is guarded by a dedicated asyncio `_lock`, a *leaf*
+lock — no router lock is ever awaited while it is held, and the router
+never holds its own `_lock` across a call into this class, so no order
+edge exists between the two.  Placement reads `placeable_cache` /
+`alive_cache`, immutable tuples rebuilt on every state change and
+swapped atomically — the hot path takes no lock at all.
+"""
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, Optional, Sequence, Tuple
+
+from areal_tpu.analysis.lockcheck import lock_guarded
+
+logger = logging.getLogger("AReaLtpu.health")
+
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+DRAINING = "draining"
+
+# Gauge encoding for areal_router_backend_state (pinned in the schema).
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2, DRAINING: 3}
+
+
+@dataclass
+class BackendState:
+    state: str = CLOSED
+    consecutive_failures: int = 0
+    last_probe: float = 0.0  # time.monotonic(); 0.0 = never probed
+    last_ok: float = 0.0
+    version: int = -1  # weight version the backend last reported
+    error: str = ""
+
+
+@lock_guarded
+class BackendHealthChecker:
+    """Probe loop + breaker bookkeeping for a fixed set of addresses.
+
+    ``probe(addr)`` is router-supplied (GET /health with a short timeout)
+    and returns the backend's health payload or raises.  ``on_death`` is
+    fired (outside the lock) exactly once per closed/half_open → open
+    transition so the router can evict rid affinity.  ``verify_rejoin``
+    gates half_open → closed.
+    """
+
+    _GUARDED_FIELDS = {"_states": "_lock"}
+
+    def __init__(
+        self,
+        addresses: Sequence[str],
+        probe: Callable[[str], Awaitable[dict]],
+        *,
+        failure_threshold: int = 3,
+        interval: float = 5.0,
+        on_death: Optional[Callable[[str], None]] = None,
+        verify_rejoin: Optional[Callable[[str, dict], Awaitable[bool]]] = None,
+    ):
+        self._lock = asyncio.Lock()
+        self._states: Dict[str, BackendState] = {
+            addr: BackendState() for addr in addresses
+        }
+        self._probe = probe
+        self._failure_threshold = max(1, failure_threshold)
+        self._interval = interval
+        self._on_death = on_death
+        self._verify_rejoin = verify_rejoin
+        self._task: Optional[asyncio.Task] = None
+        # Immutable placement views, swapped atomically on state change;
+        # readers take no lock (tuple reference read is atomic in CPython).
+        self.placeable_cache: Tuple[str, ...] = tuple(addresses)
+        self.alive_cache: Tuple[str, ...] = tuple(addresses)
+
+    # --- lifecycle ---
+
+    def start(self):
+        if self._task is None and self._interval > 0:
+            self._task = asyncio.get_running_loop().create_task(
+                self._probe_loop()
+            )
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _probe_loop(self):
+        while True:
+            try:
+                await self.probe_now()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("health probe sweep failed")
+            await asyncio.sleep(self._interval)
+
+    async def probe_now(self):
+        """One probe sweep over every backend, concurrently."""
+        async with self._lock:
+            addrs = list(self._states)
+        await asyncio.gather(
+            *(self._probe_one(a) for a in addrs), return_exceptions=True
+        )
+
+    # --- probe + breaker transitions ---
+
+    async def _probe_one(self, addr: str):
+        try:
+            health = await self._probe(addr)
+        except Exception as e:  # noqa: BLE001 — any probe failure counts
+            async with self._lock:
+                st = self._states.get(addr)
+                if st is not None:
+                    st.last_probe = time.monotonic()
+            await self.report_failure(addr, repr(e))
+            return
+
+        rejoining = False
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is None:
+                return
+            now = time.monotonic()
+            st.last_probe = now
+            st.last_ok = now
+            st.error = ""
+            st.version = int(health.get("version", st.version))
+            if st.state == CLOSED:
+                st.consecutive_failures = 0
+            elif st.state == OPEN:
+                # answered after death: candidate, but gate re-admission
+                # on the rejoin check (stale weights must not place)
+                st.state = HALF_OPEN
+                rejoining = True
+                self._rebuild_cache_locked()
+            elif st.state == HALF_OPEN:
+                rejoining = True
+            # DRAINING: record the probe, never auto-transition
+
+        if rejoining:
+            await self._complete_rejoin(addr, health)
+
+    async def _complete_rejoin(self, addr: str, health: dict):
+        ok = True
+        if self._verify_rejoin is not None:
+            try:
+                ok = await self._verify_rejoin(addr, health)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("rejoin verify for %s raised: %r", addr, e)
+                ok = False
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is None or st.state != HALF_OPEN:
+                return
+            if ok:
+                st.state = CLOSED
+                st.consecutive_failures = 0
+                logger.info("backend %s rejoined the fleet", addr)
+            else:
+                st.state = OPEN
+                st.consecutive_failures = self._failure_threshold
+                st.error = "rejoin verification failed"
+            self._rebuild_cache_locked()
+
+    async def report_failure(self, addr: str, error: str = ""):
+        """Count a probe/request failure against `addr`; trips the breaker
+        at `failure_threshold` consecutive failures (immediately if the
+        backend was half-open)."""
+        died = False
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is None or st.state == DRAINING:
+                return
+            st.consecutive_failures += 1
+            st.error = error
+            if st.state == HALF_OPEN or (
+                st.state == CLOSED
+                and st.consecutive_failures >= self._failure_threshold
+            ):
+                st.state = OPEN
+                died = True
+                self._rebuild_cache_locked()
+        if died:
+            logger.warning("backend %s declared dead: %s", addr, error)
+            if self._on_death is not None:
+                res = self._on_death(addr)
+                if asyncio.iscoroutine(res):
+                    await res
+
+    async def report_success(self, addr: str):
+        """A proxied request succeeded.  Only resets the failure streak of
+        a CLOSED backend — recovery from OPEN must go through the probe +
+        rejoin-verify path so stale weights never slip back in."""
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is not None and st.state == CLOSED:
+                st.consecutive_failures = 0
+                st.last_ok = time.monotonic()
+                st.error = ""
+
+    # --- operator drain ---
+
+    async def drain(self, addr: str) -> bool:
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is None:
+                return False
+            st.state = DRAINING
+            self._rebuild_cache_locked()
+            return True
+
+    async def undrain(self, addr: str) -> bool:
+        async with self._lock:
+            st = self._states.get(addr)
+            if st is None or st.state != DRAINING:
+                return False
+            st.state = CLOSED
+            st.consecutive_failures = 0
+            self._rebuild_cache_locked()
+            return True
+
+    # --- views ---
+
+    def _rebuild_cache_locked(self):  # holds: _lock
+        self.placeable_cache = tuple(
+            a for a, s in self._states.items() if s.state == CLOSED
+        )
+        # alive = will answer HTTP: everything not tripped open.  Draining
+        # backends stay in fanouts (they must receive the final publishes)
+        # but not in placement; half-open ones are excluded from both
+        # placement and publish until rejoin-verified.
+        self.alive_cache = tuple(
+            a
+            for a, s in self._states.items()
+            if s.state in (CLOSED, DRAINING)
+        )
+
+    async def snapshot(self) -> Dict[str, dict]:
+        """Cached state for the /health handler — no probes issued."""
+        now = time.monotonic()
+        async with self._lock:
+            return {
+                addr: {
+                    "state": st.state,
+                    "consecutive_failures": st.consecutive_failures,
+                    "version": st.version,
+                    "age_s": (
+                        round(now - st.last_probe, 3)
+                        if st.last_probe > 0
+                        else None
+                    ),
+                    "error": st.error,
+                }
+                for addr, st in self._states.items()
+            }

@@ -42,6 +42,7 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.analysis.lockcheck import lock_guarded
+from areal_tpu.gen.health import STATE_CODES, BackendHealthChecker
 from areal_tpu.utils import logging, name_resolve, names, network, telemetry
 
 logger = logging.getLogger("gen.router")
@@ -68,6 +69,13 @@ class RouterConfig:
     # allocations older than this are reclaimed, so a client that crashed
     # mid-episode cannot permanently wedge fleet admission (0 => request_timeout)
     alloc_ttl: float = 0.0
+    # active health checking / circuit breaker (gen/health.py): probe every
+    # interval seconds (0 disables the background loop; state still updates
+    # from proxied-request outcomes), trip a backend open after this many
+    # consecutive failures
+    health_check_interval: float = 5.0
+    health_failure_threshold: int = 3
+    health_probe_timeout: float = 2.0
 
 
 @lock_guarded
@@ -76,7 +84,13 @@ class Router:
     # reads or mutates it does so under the asyncio _lock so capacity
     # checks are atomic with lease grants (areal-lint C1; the asyncio
     # flavor of the runtime check degrades to a locked() probe)
-    _GUARDED_FIELDS = {"_running": "_lock", "_accepted": "_lock"}
+    _GUARDED_FIELDS = {
+        "_running": "_lock",
+        "_accepted": "_lock",
+        "_failovers": "_lock",
+        "_publish_partial_failures": "_lock",
+        "_last_publish": "_lock",
+    }
     # declared acquisition order (areal-lint C5): _flush_and_update holds
     # the flush serializer across the backend fan-out, then takes the
     # ledger lock to commit — never nest them the other way around
@@ -101,29 +115,74 @@ class Router:
         self._watcher: Optional[asyncio.Task] = None
         self._version_poller: Optional[asyncio.Task] = None
         self.n_flushes = 0
+        # failure-handling state (ISSUE 11): circuit breaker + failover
+        # bookkeeping.  _last_publish remembers (path, version) of the last
+        # successful disk publish so a rejoining backend can be force-fed
+        # current weights before it takes placements again.
+        self._health: Optional[BackendHealthChecker] = None
+        self._failovers = 0
+        self._publish_partial_failures = 0
+        self._last_publish: Optional[tuple] = None
 
     # ---------------------------- scheduling ----------------------------
 
+    def _placeable(self) -> List[str]:
+        """Backends eligible for NEW placements: the breaker's closed set,
+        in canonical address order (so round-robin indices are stable when
+        everyone is healthy).  Falls back to the full list when the whole
+        fleet is tripped — routing into a dead fleet fails fast per-request
+        and keeps probing, instead of crashing the scheduler."""
+        if self._health is None:
+            return self.addresses
+        ok = set(self._health.placeable_cache)
+        pool = [a for a in self.addresses if a in ok]
+        return pool or self.addresses
+
     def _choose(self) -> str:
+        pool = self._placeable()
         policy = self.config.schedule_policy
         if policy == "least_requests":
-            return min(self.addresses, key=lambda a: self._inflight.get(a, 0))
+            return min(pool, key=lambda a: self._inflight.get(a, 0))
         if policy == "least_tokens":
-            return min(self.addresses, key=lambda a: self._tokens.get(a, 0))
-        addr = self.addresses[self._rr % len(self.addresses)]
+            return min(pool, key=lambda a: self._tokens.get(a, 0))
+        addr = pool[self._rr % len(pool)]
         self._rr += 1
         return addr
 
     def _server_for_rid(self, rid: str) -> str:
         if rid and rid in self._rid_to_addr:
-            self._rid_to_addr.move_to_end(rid)
-            return self._rid_to_addr[rid]
+            addr = self._rid_to_addr[rid]
+            if addr in self._placeable():
+                self._rid_to_addr.move_to_end(rid)
+                return addr
+            # affinity points at a dead/draining backend: the KV prefix is
+            # gone anyway, so re-place (whole groups share one key, so GRPO
+            # siblings reroute together and fan-out prefix sharing survives)
+            del self._rid_to_addr[rid]
         addr = self._choose()
         if rid:
             if len(self._rid_to_addr) >= RID_CACHE_SIZE:
                 self._rid_to_addr.popitem(last=False)
             self._rid_to_addr[rid] = addr
         return addr
+
+    def _evict_backend_locked(self, addr: str) -> int:  # holds: _lock
+        """Drop every rid affinity pinned to `addr`; returns the count.
+        Called on death so resubmissions re-place instead of chasing the
+        corpse, and each evicted key is one failover."""
+        evicted = [r for r, a in self._rid_to_addr.items() if a == addr]
+        for r in evicted:
+            del self._rid_to_addr[r]
+        self._failovers += len(evicted)
+        return len(evicted)
+
+    async def _on_backend_death(self, addr: str):
+        """Breaker callback (closed/half_open -> open)."""
+        async with self._lock:
+            n = self._evict_backend_locked(addr)
+        logger.warning(
+            f"backend {addr} dead: rerouted {n} rid/group affinities"
+        )
 
     # ------------------------- staleness gate ---------------------------
 
@@ -169,15 +228,24 @@ class Router:
             self._routed[addr] = self._routed.get(addr, 0) + 1
             self._tokens[addr] = self._tokens.get(addr, 0) + n_prompt
         try:
-            async with self._session.post(
-                f"http://{addr}/generate", json=body
-            ) as resp:
-                payload = await resp.json()
-                status = resp.status
-        finally:
-            async with self._lock:
-                self._inflight[addr] = self._inflight.get(addr, 1) - 1
-                self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_prompt)
+            try:
+                async with self._session.post(
+                    f"http://{addr}/generate", json=body
+                ) as resp:
+                    payload = await resp.json()
+                    status = resp.status
+            finally:
+                async with self._lock:
+                    self._inflight[addr] = max(
+                        0, self._inflight.get(addr, 1) - 1
+                    )
+                    self._tokens[addr] = max(
+                        0, self._tokens.get(addr, 0) - n_prompt
+                    )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return await self._proxy_failed(addr, rid, e)
+        if self._health is not None and status == 200:
+            await self._health.report_success(addr)
         return web.json_response(payload, status=status)
 
     async def generate_batch(self, request: web.Request) -> web.Response:
@@ -197,16 +265,43 @@ class Router:
             self._routed[addr] = self._routed.get(addr, 0) + len(reqs)
             self._tokens[addr] = self._tokens.get(addr, 0) + n_prompt
         try:
-            async with self._session.post(
-                f"http://{addr}/generate_batch", json=body
-            ) as resp:
-                payload = await resp.json()
-                status = resp.status
-        finally:
-            async with self._lock:
-                self._inflight[addr] = self._inflight.get(addr, len(reqs)) - len(reqs)
-                self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_prompt)
+            try:
+                async with self._session.post(
+                    f"http://{addr}/generate_batch", json=body
+                ) as resp:
+                    payload = await resp.json()
+                    status = resp.status
+            finally:
+                async with self._lock:
+                    self._inflight[addr] = max(
+                        0, self._inflight.get(addr, len(reqs)) - len(reqs)
+                    )
+                    self._tokens[addr] = max(
+                        0, self._tokens.get(addr, 0) - n_prompt
+                    )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return await self._proxy_failed(addr, key, e)
+        if self._health is not None and status == 200:
+            await self._health.report_success(addr)
         return web.json_response(payload, status=status)
+
+    async def _proxy_failed(
+        self, addr: str, rid: str, exc: BaseException
+    ) -> web.Response:
+        """A proxied request died at the transport layer: count the strike
+        against the backend's breaker, break this rid's affinity so its
+        resubmission re-places, and surface 502 — the client's failover
+        loop (RemoteInfEngine) owns the resubmit, because only it knows the
+        tokens generated so far."""
+        async with self._lock:
+            if self._rid_to_addr.get(rid) == addr:
+                del self._rid_to_addr[rid]
+                self._failovers += 1
+        if self._health is not None:
+            await self._health.report_failure(addr, repr(exc))
+        return web.json_response(
+            {"error": f"backend {addr} unreachable: {exc!r}"}, status=502
+        )
 
     async def allocate_request(self, request: web.Request) -> web.Response:
         """Admission control for a new rollout sample.  Returns an allocation
@@ -242,7 +337,13 @@ class Router:
         async with self._lock:
             if alloc_id in self._running:
                 del self._running[alloc_id]
-            elif not alloc_id and self._running:
+            elif alloc_id:
+                # the lease was TTL-reclaimed (client stalled past alloc_ttl
+                # and its slot is already re-placeable): reject the late
+                # completion outright — counting it would double-book the
+                # admission budget against whoever now holds the slot
+                return web.json_response({"ok": False, "expired": True})
+            elif self._running:
                 # legacy caller without a lease id: free the oldest.  A
                 # KNOWN-but-absent id (TTL-pruned lease) must NOT pop some
                 # other client's live lease — that would double-free
@@ -279,25 +380,45 @@ class Router:
         return web.json_response({"ok": True})
 
     async def health(self, request: web.Request) -> web.Response:
-        async def probe(a: str):
-            try:
-                async with self._session.get(
-                    f"http://{a}/health", timeout=aiohttp.ClientTimeout(total=5)
-                ) as resp:
-                    return a, await resp.json()
-            except Exception as e:  # noqa: BLE001 — report, don't die
-                return a, {"status": "unreachable", "error": str(e)}
-
-        # concurrent probes: N partially-dead backends cost ~5s, not 5*N
-        states = dict(
-            await asyncio.gather(*[probe(a) for a in self.addresses])
+        """Serve the health-checker's CACHED view (satellite: the old code
+        re-probed all backends inline per scrape — 5 s worst case per hit).
+        One probe sweep is only forced when a backend has never been
+        probed at all (startup race, or probe loop disabled in tests)."""
+        if self._health is None:
+            return web.json_response(
+                {"status": "starting", "version": self.version, "servers": {}},
+                status=503,
+            )
+        states = await self._health.snapshot()
+        if any(s["age_s"] is None for s in states.values()):
+            await self._health.probe_now()
+            states = await self._health.snapshot()
+        ok = all(
+            s["state"] in ("closed", "draining") for s in states.values()
         )
-        ok = all(s.get("status") in ("ok", "paused") for s in states.values())
+        freshness = max(
+            (s["age_s"] for s in states.values() if s["age_s"] is not None),
+            default=None,
+        )
         return web.json_response(
             {"status": "ok" if ok else "degraded", "version": self.version,
-             "servers": states},
+             "servers": states, "freshness_s": freshness},
             status=200 if ok else 503,
         )
+
+    async def drain(self, request: web.Request) -> web.Response:
+        """Operator-requested graceful removal: no new placements, but the
+        backend keeps receiving fanouts so in-flight work completes."""
+        body = await request.json()
+        addr = body.get("addr", "")
+        ok = self._health is not None and await self._health.drain(addr)
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def undrain(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        addr = body.get("addr", "")
+        ok = self._health is not None and await self._health.undrain(addr)
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
 
     async def metrics(self, request: web.Request) -> web.Response:
         # ledger fields are lock-guarded (C1), so the Prometheus path must
@@ -314,7 +435,12 @@ class Router:
                 "accepted": self._accepted,
                 "capacity": cap,
                 "n_flushes": self.n_flushes,
+                "failovers": self._failovers,
+                "publish_partial_failures": self._publish_partial_failures,
             }
+        snap["backend_states"] = (
+            await self._health.snapshot() if self._health is not None else {}
+        )
         if telemetry.wants_prometheus(
             request.query.get("format"), request.headers.get("Accept", "")
         ):
@@ -344,6 +470,21 @@ class Router:
                 reg.gauge("tokens_inflight", "in-flight tokens per backend").set(
                     v, server=addr
                 )
+            reg.counter(
+                "failovers_total",
+                "rid/group affinities rerouted off failed backends",
+            ).set_total(snap["failovers"])
+            reg.counter(
+                "areal_publish_partial_failures_total",
+                "fleet members missed by weight publishes",
+            ).set_total(snap["publish_partial_failures"])
+            state_gauge = reg.gauge(
+                "backend_state",
+                "circuit state per backend "
+                "(0=closed 1=half_open 2=open 3=draining)",
+            )
+            for addr, st in snap["backend_states"].items():
+                state_gauge.set(STATE_CODES[st["state"]], server=addr)
             return web.Response(
                 text=reg.render_prometheus(), content_type="text/plain"
             )
@@ -361,22 +502,51 @@ class Router:
             resp.raise_for_status()
             return await resp.json()
 
-    async def _fanout(self, endpoint: str, payload: dict, timeout: float = 300.0):
-        return await asyncio.gather(
-            *[self._one_post(a, endpoint, payload, timeout) for a in self.addresses]
+    def _alive_targets(self) -> List[str]:
+        """Fanout recipients: everyone the breaker considers reachable
+        (closed + draining).  Tripped-open backends are skipped — they get
+        current weights through the rejoin path instead."""
+        if self._health is None:
+            return self.addresses
+        alive = set(self._health.alive_cache)
+        return [a for a in self.addresses if a in alive]
+
+    async def _fanout(
+        self,
+        endpoint: str,
+        payload: dict,
+        timeout: float = 300.0,
+        targets: Optional[List[str]] = None,
+    ) -> Dict[str, object]:
+        """POST to each target, returning per-server outcomes (an Exception
+        value marks that server's failure) — one dead fleet member must
+        never wedge a whole fanout behind its timeout."""
+        if targets is None:
+            targets = self._alive_targets()
+        results = await asyncio.gather(
+            *[self._one_post(a, endpoint, payload, timeout) for a in targets],
+            return_exceptions=True,
         )
+        return dict(zip(targets, results))
 
     async def _flush_and_update(self, path: str, version: Optional[int]) -> int:
         """Pause every backend (in-flight requests abort and resume client-
         side with fresh weights — interruptible generation), swap weights,
         resume (reference flush_requests_and_update_weights,
-        gserver_manager.py:158)."""
+        gserver_manager.py:158).
+
+        Degraded mode: the publish proceeds over whatever subset of the
+        fleet is reachable; per-server failures are counted (and strike the
+        breaker) rather than failing the publish, as long as at least one
+        backend took the weights."""
         async with self._flush_lock:
+            targets = self._alive_targets()
             try:
-                await self._fanout("/pause_generation", {})
-                results = await self._fanout(
+                await self._fanout("/pause_generation", {}, targets=targets)
+                outcomes = await self._fanout(
                     "/update_weights_from_disk",
                     {"path": path, "version": version},
+                    targets=targets,
                 )
             finally:
                 # always resume — a failed pause/update on one backend must
@@ -384,20 +554,98 @@ class Router:
                 await asyncio.gather(
                     *[
                         self._one_post(a, "/continue_generation", {})
-                        for a in self.addresses
+                        for a in targets
                     ],
                     return_exceptions=True,
                 )
+            successes = {
+                a: r
+                for a, r in outcomes.items()
+                if not isinstance(r, BaseException)
+            }
+            for a, r in outcomes.items():
+                if isinstance(r, BaseException):
+                    logger.warning(
+                        f"weight publish to {a} failed: {r!r}"
+                    )
+                    if self._health is not None:
+                        await self._health.report_failure(a, repr(r))
+            if not successes:
+                raise RuntimeError(
+                    f"weight publish {path} v{version} reached no backend "
+                    f"(targets={targets})"
+                )
+            missed = len(self.addresses) - len(successes)
             async with self._lock:
                 self.version = (
                     version
                     if version is not None
-                    else max(r.get("version", 0) for r in results)
+                    else max(r.get("version", 0) for r in successes.values())
                 )
                 self.n_flushes += 1
-            logger.info(f"weights updated to v{self.version} on "
-                        f"{len(self.addresses)} servers")
+                self._last_publish = (path, self.version)
+                self._publish_partial_failures += missed
+            if missed:
+                logger.warning(
+                    f"degraded publish: v{self.version} on "
+                    f"{len(successes)}/{len(self.addresses)} servers "
+                    f"({missed} missed; rejoin will reload them)"
+                )
+            else:
+                logger.info(f"weights updated to v{self.version} on "
+                            f"{len(successes)} servers")
             return self.version
+
+    async def _probe_backend(self, addr: str) -> dict:
+        """Health-checker probe: GET /health with a short timeout (a probe
+        must answer fast or count as a failure; the default request timeout
+        is an hour)."""
+        async with self._session.get(
+            f"http://{addr}/health",
+            timeout=aiohttp.ClientTimeout(
+                total=self.config.health_probe_timeout
+            ),
+        ) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _verify_rejoin(self, addr: str, health: dict) -> bool:
+        """Gate for half_open -> closed: a backend that answered after being
+        declared dead (restart, network heal) may be serving stale weights.
+        Check its served version against the fleet's; force-reload from the
+        last published checkpoint when behind.  Returning False keeps it
+        tripped open until the next probe retries."""
+        served = int(health.get("version", -1))
+        async with self._lock:
+            fleet = self.version
+            last = self._last_publish
+        if served >= fleet:
+            return True
+        if last is None:
+            # no disk publish on record (transfer-mode fleet, or no publish
+            # yet): nothing to reload from — admit and let the trainer's
+            # next transfer publish catch it up
+            logger.warning(
+                f"rejoining {addr} serves v{served} < fleet v{fleet} but no "
+                "publish path is recorded; admitting as-is"
+            )
+            return True
+        path, _ = last
+        try:
+            result = await self._one_post(
+                addr,
+                "/update_weights_from_disk",
+                {"path": path, "version": fleet},
+            )
+        except Exception as e:  # noqa: BLE001 — any failure blocks rejoin
+            logger.warning(f"rejoin reload of {addr} failed: {e!r}")
+            return False
+        reloaded = int(result.get("version", -1))
+        logger.info(
+            f"rejoining {addr}: force-reloaded v{served} -> v{reloaded} "
+            f"(fleet v{fleet})"
+        )
+        return reloaded >= fleet
 
     async def _poll_backend_versions(self):
         """Transfer-mode safety net: the binary-chunk commit bumps each gen
@@ -467,6 +715,15 @@ class Router:
         self._inflight = {a: 0 for a in self.addresses}
         self._routed = {a: 0 for a in self.addresses}
         self._tokens = {a: 0 for a in self.addresses}
+        self._health = BackendHealthChecker(
+            self.addresses,
+            self._probe_backend,
+            failure_threshold=self.config.health_failure_threshold,
+            interval=self.config.health_check_interval,
+            on_death=self._on_backend_death,
+            verify_rejoin=self._verify_rejoin,
+        )
+        self._health.start()
         if self.config.weights_path and self.config.experiment_name:
             self._watcher = asyncio.create_task(self._watch_checkpoints())
         elif (
@@ -497,6 +754,8 @@ class Router:
             self._watcher.cancel()
         if self._version_poller is not None:
             self._version_poller.cancel()
+        if self._health is not None:
+            await self._health.stop()
         if self._session is not None:
             await self._session.close()
 
@@ -510,6 +769,8 @@ class Router:
         app.router.add_post("/set_version", self.set_version)
         app.router.add_post("/pause_generation", self.pause)
         app.router.add_post("/continue_generation", self.resume)
+        app.router.add_post("/drain", self.drain)
+        app.router.add_post("/undrain", self.undrain)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         app.on_startup.append(self.on_startup)

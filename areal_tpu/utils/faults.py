@@ -1,0 +1,268 @@
+"""Deterministic fault injection for the rollout fleet (ISSUE 11).
+
+A `FaultPlan` is an explicit table keyed by ``(endpoint, call_index)``:
+the Nth call a server sees on an endpoint either proceeds normally or
+hits the planned fault.  Plans are either written out literally in a
+test or generated from a seed (`FaultPlan.generate`) — same seed, same
+table, same injected-failure sequence, so chaos runs replay exactly and
+a failure found in CI reproduces locally from one integer.
+
+Fault kinds (what the transport layer can express in-process):
+
+- ``http_500``   — the handler answers HTTP 500 (backend error path);
+- ``slow``       — the response is delayed by ``delay_s`` (latency spike);
+- ``hang``       — the response is held past any sane client timeout
+                   (mid-stream stall; ``delay_s`` is the hold time);
+- ``disconnect`` — the server closes the TCP transport mid-request
+                   (connection reset, the ambiguous-failure case).
+
+True connection-refused and process death cannot be faked from inside a
+live handler: they come from stopping the server (tests/fake_server.py
+``stop()`` on a fixed port) or from `kill_process` on a real gen-server
+subprocess — the plan's job is everything short of that.
+
+Wiring: tests/fake_server.py consults ``fault_plan.decide(endpoint)`` at
+the top of each handler; `apply_fault` turns the decision into aiohttp
+behavior.  ``scripts/bench_e2e_grpo.py --chaos`` mounts a `FaultProxy`
+in front of a real gen server so the same plans drive real engines.
+"""
+
+import asyncio
+import json
+import random
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FAULT_KINDS = ("http_500", "slow", "hang", "disconnect")
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str  # one of FAULT_KINDS
+    delay_s: float = 0.0  # slow: added latency; hang: hold duration
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+class FaultPlan:
+    """{(endpoint, call_index): Fault} plus per-endpoint call counters.
+
+    ``decide`` is thread-safe (fake servers run handlers on their own
+    loop threads) and records every injection in ``injected`` so a run
+    can report — and a repeat-run test can assert — the exact sequence.
+    """
+
+    def __init__(self, plan: Optional[Dict[Tuple[str, int], Fault]] = None):
+        self.plan: Dict[Tuple[str, int], Fault] = dict(plan or {})
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self.injected: List[Tuple[str, int, str]] = []
+
+    def decide(self, endpoint: str) -> Optional[Fault]:
+        """Count this call on `endpoint`; return the planned fault, if any."""
+        with self._lock:
+            idx = self._counts.get(endpoint, 0)
+            self._counts[endpoint] = idx + 1
+            fault = self.plan.get((endpoint, idx))
+            if fault is not None:
+                self.injected.append((endpoint, idx, fault.kind))
+            return fault
+
+    def reset_counters(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self.injected.clear()
+
+    def injected_log(self) -> List[Tuple[str, int, str]]:
+        with self._lock:
+            return list(self.injected)
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        endpoints: Sequence[str] = ("/generate",),
+        n_calls: int = 64,
+        rate: float = 0.15,
+        kinds: Sequence[str] = ("http_500", "slow", "disconnect"),
+        slow_s: float = 0.05,
+        hang_s: float = 30.0,
+    ) -> "FaultPlan":
+        """Seeded plan over the first `n_calls` calls of each endpoint.
+        `random.Random(seed)` is stable across processes and platform, so
+        the table — and therefore the injected sequence — is a pure
+        function of the arguments."""
+        rng = random.Random(seed)
+        plan: Dict[Tuple[str, int], Fault] = {}
+        for ep in endpoints:
+            for idx in range(n_calls):
+                if rng.random() < rate:
+                    kind = kinds[rng.randrange(len(kinds))]
+                    delay = {"slow": slow_s, "hang": hang_s}.get(kind, 0.0)
+                    plan[(ep, idx)] = Fault(kind, delay)
+        return plan_or_empty(cls(plan))
+
+    # --- serialization (bench reports / replay files) ---
+    def to_dict(self) -> Dict[str, Dict[str, float | str]]:
+        return {
+            f"{ep}|{idx}": {"kind": f.kind, "delay_s": f.delay_s}
+            for (ep, idx), f in sorted(self.plan.items())
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Dict]) -> "FaultPlan":
+        plan = {}
+        for key, spec in d.items():
+            ep, idx = key.rsplit("|", 1)
+            plan[(ep, int(idx))] = Fault(spec["kind"],
+                                         float(spec.get("delay_s", 0.0)))
+        return cls(plan)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+def plan_or_empty(plan: Optional["FaultPlan"]) -> "FaultPlan":
+    return plan if plan is not None else FaultPlan()
+
+
+async def apply_fault(fault: Optional[Fault], request):
+    """Turn a decision into aiohttp handler behavior.  Returns a Response
+    for faults that answer (http_500), None for pass-through faults
+    (slow delays then continues), and raises for transport-level ones —
+    the caller must `return` a non-None result and propagate raises."""
+    from aiohttp import web
+
+    if fault is None:
+        return None
+    if fault.kind == "slow":
+        await asyncio.sleep(fault.delay_s)
+        return None
+    if fault.kind == "http_500":
+        return web.json_response(
+            {"error": "injected fault: http_500"}, status=500
+        )
+    if fault.kind == "hang":
+        # hold the request open past the client's timeout; the sleep is
+        # cancelled when the client goes away or the server stops
+        await asyncio.sleep(fault.delay_s or 3600.0)
+        return web.json_response(
+            {"error": "injected fault: hang elapsed"}, status=500
+        )
+    if fault.kind == "disconnect":
+        # mid-stream transport kill: the client sees a connection reset,
+        # the ambiguous did-it-commit failure mode
+        if request.transport is not None:
+            request.transport.close()
+        raise ConnectionResetError("injected fault: disconnect")
+    raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+
+def kill_process(proc, timeout: float = 10.0) -> Optional[int]:
+    """SIGKILL a real gen-server subprocess and reap it — the one fault an
+    in-process injector cannot express (no flush, no goodbye, exactly like
+    an OOM-killed or preempted fleet member)."""
+    import signal
+
+    if proc.poll() is None:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    try:
+        proc.wait(timeout=timeout)
+    except Exception:  # noqa: BLE001 — caller inspects returncode
+        pass
+    return proc.returncode
+
+
+class FaultProxy:
+    """A fault-injecting HTTP forwarder for chaos runs against REAL gen
+    servers: sits on its own port, applies the plan's decision for each
+    (endpoint, call_index), and otherwise forwards the request verbatim
+    to the upstream server.  Runs on a background thread+loop exactly
+    like tests/fake_server.py so sync bench code can own it."""
+
+    def __init__(self, upstream_addr: str, plan: FaultPlan):
+        self.upstream = upstream_addr
+        self.plan = plan
+        self.port: Optional[int] = None
+        self._runner = None
+        self._session = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    async def _forward(self, request):
+        from aiohttp import web
+
+        faulted = await apply_fault(self.plan.decide(request.path), request)
+        if faulted is not None:
+            return faulted
+        body = await request.read()
+        async with self._session.request(
+            request.method,
+            f"http://{self.upstream}{request.path_qs}",
+            data=body if body else None,
+            headers={
+                k: v for k, v in request.headers.items()
+                if k.lower() not in ("host", "content-length")
+            },
+        ) as resp:
+            payload = await resp.read()
+            return web.Response(
+                body=payload,
+                status=resp.status,
+                content_type=resp.content_type,
+            )
+
+    def start(self) -> str:
+        import aiohttp
+        from aiohttp import web
+
+        def _run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _serve():
+                self._session = aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=600),
+                    connector=aiohttp.TCPConnector(limit=0),
+                )
+                app = web.Application(client_max_size=1024**3)
+                app.router.add_route("*", "/{tail:.*}", self._forward)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                self._started.set()
+
+            self._loop.run_until_complete(_serve())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("fault proxy failed to start")
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._loop is None:
+            return
+
+        async def _cleanup():
+            if self._session is not None:
+                await self._session.close()
+            if self._runner is not None:
+                await self._runner.cleanup()
+
+        asyncio.run_coroutine_threadsafe(_cleanup(), self._loop).result(
+            timeout=5
+        )
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)

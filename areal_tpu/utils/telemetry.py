@@ -350,6 +350,15 @@ STALENESS_AT_CONSUMPTION = TRAIN.histogram(
     "consumed_version - behavior_version per trajectory row at train_batch",
     buckets=STALENESS_BUCKETS,
 )
+# Fault-tolerance evidence (ISSUE 11).  Registered at module import so the
+# pinned metric appears on the train /metrics surface (TYPE line) even
+# before the first backend ever fails; the client-side failover loop in
+# core/remote.py increments it.  The name is already fully qualified, so
+# the registry serves it verbatim rather than namespacing it.
+CLIENT_RESUBMISSIONS = TRAIN.counter(
+    "areal_client_resubmissions_total",
+    "Trajectories resubmitted to another server after a backend failure",
+)
 
 
 # ---------------------------------------------------------------------------
@@ -484,12 +493,14 @@ def register_staleness(reg: Registry, manager: Any) -> None:
     sub = reg.gauge("rollout_submitted", "Rollouts submitted (RolloutStat)")
     run = reg.gauge("rollout_running", "Rollouts in flight (RolloutStat)")
     acc = reg.gauge("rollout_accepted", "Rollouts accepted (RolloutStat)")
+    rej = reg.gauge("rollout_rejected", "Rollouts rejected (RolloutStat)")
 
     def _collect():
         st = manager.get_stats()
         sub.set(st.submitted)
         run.set(st.running)
         acc.set(st.accepted)
+        rej.set(getattr(st, "rejected", 0))
 
     reg.add_collector(_collect)
 

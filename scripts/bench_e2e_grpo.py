@@ -429,6 +429,18 @@ def main():
                    help="colocated = in-process ColocatedEngine handoff; "
                         "remote = REAL GenServer over HTTP + RemoteJaxEngine "
                         "+ transfer-mode weight publish (the fleet slice)")
+    p.add_argument("--chaos", action="store_true",
+                   help="mount a seeded FaultProxy (utils/faults.py) "
+                        "between the client and the gen server: HTTP 500s, "
+                        "latency spikes, and mid-request disconnects replay "
+                        "deterministically from --chaos-seed; reports "
+                        "goodput + trajectory-loss fraction under fire. "
+                        "Requires --transport remote and async-only --modes")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="one integer reproduces the exact injected-failure "
+                        "sequence (FaultPlan.generate)")
+    p.add_argument("--chaos-rate", type=float, default=0.15,
+                   help="per-call fault probability in the generated plan")
     p.add_argument("--telemetry-dir", default="",
                    help="enable unified telemetry (utils/telemetry.py) and "
                         "dump events.jsonl + trace.json (Perfetto) + "
@@ -449,6 +461,14 @@ def main():
     if args.dataset == "gsm8k-synth" and args.workflow != "rlvr":
         p.error("--dataset gsm8k-synth runs the RLVR workflow (its reward "
                 "parses \\boxed{} answers, not multi-turn feedback)")
+    if args.chaos:
+        if args.transport != "remote":
+            p.error("--chaos requires --transport remote (faults are "
+                    "injected at the HTTP boundary)")
+        if any(m != "async" for m in args.modes.split(",")):
+            p.error("--chaos runs async modes only: a sync rollout_batch "
+                    "waits for its exact batch, so one lost trajectory "
+                    "hangs the step; prepare_batch keeps consuming")
     if args.workflow == "multi_turn" and args.len_jitter > 0:
         # MultiTurnWorkflow generates with its fixed gconfig budget; per-item
         # budgets would be ignored and the result JSON would claim a
@@ -486,6 +506,7 @@ def main():
         share_prefix=args.share_prefix == "on",
     )
     client = server_engine = stop_server = meta = None
+    chaos_plan = chaos_proxy = None
     if args.transport == "remote":
         from areal_tpu.api.config import InferenceEngineConfig
         from areal_tpu.api.io_struct import WeightUpdateMeta
@@ -494,6 +515,27 @@ def main():
         server_engine, _server, addr, stop_server = _make_remote_parts(
             args, actor, cfg
         )
+        client_addr = addr
+        if args.chaos:
+            from areal_tpu.utils.faults import FaultPlan, FaultProxy
+
+            # generate() excludes "hang" by default — a held request would
+            # stall the run for the full client timeout, which measures the
+            # timeout constant, not the failover machinery
+            chaos_plan = FaultPlan.generate(
+                seed=args.chaos_seed,
+                n_calls=args.batch_size * (args.warmup + args.steps) * 8,
+                rate=args.chaos_rate,
+            )
+            chaos_proxy = FaultProxy(addr, chaos_plan)
+            client_addr = chaos_proxy.start()
+            # the client talks through the proxy; the trainer's transfer
+            # publish goes straight to the real server via
+            # AREAL_LLM_SERVER_ADDRS (set in _make_remote_parts), so weight
+            # chunks are not subject to generation-path faults
+            print(f"chaos proxy on {client_addr} -> {addr} "
+                  f"(seed={args.chaos_seed}, {len(chaos_plan.plan)} faults "
+                  f"planned)", file=sys.stderr, flush=True)
         client = RemoteJaxEngine(InferenceEngineConfig(
             experiment_name="e2e-bench", trial_name="b",
             consumer_batch_size=args.batch_size,
@@ -501,7 +543,7 @@ def main():
             max_head_offpolicyness=4,
             request_timeout=600,
         ))
-        client.initialize(addr=addr)
+        client.initialize(addr=client_addr)
         meta = WeightUpdateMeta.from_transfer(
             "e2e-bench", "b", chunk_mb=64,
             live_commit=not interrupt_publish,
@@ -662,6 +704,21 @@ def main():
                     st["shared_tokens"] / max(total_prefill, 1), 3
                 ),
             }
+        if args.chaos:
+            st = client.executor.staleness_manager.get_stats()
+            lost = int(client.executor.lost_trajectories)
+            result["chaos"] = {
+                "seed": args.chaos_seed,
+                "rate": args.chaos_rate,
+                "plan_size": len(chaos_plan.plan),
+                # the replayable record: same seed -> same sequence
+                "injected": [list(t) for t in chaos_plan.injected_log()],
+                "lost_trajectories": lost,
+                "submitted": int(st.submitted),
+                "trajectory_loss_fraction": round(
+                    lost / max(1, st.submitted), 4
+                ),
+            }
         if args.telemetry_dir:
             events_path = os.path.join(args.telemetry_dir, "events.jsonl")
             trace_path = os.path.join(args.telemetry_dir, "trace.json")
@@ -691,6 +748,8 @@ def main():
         try:
             if client is not None:
                 client.destroy()
+            if chaos_proxy is not None:
+                chaos_proxy.stop()
             if stop_server is not None:
                 stop_server()
             if serving is not None:

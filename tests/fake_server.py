@@ -5,6 +5,12 @@ The reference tests system logic against FastAPI fake SGLang servers
 an aiohttp server that "generates" deterministic tokens chunk-by-chunk, so
 client code (RemoteInfEngine, workflows, executor) is exercised against real
 sockets, including the abort/interruption path.
+
+Fault injection (ISSUE 11): pass a `FaultPlan` and every handler consults
+it by (endpoint, call-index) before doing real work — HTTP 500s, latency
+spikes, hangs, and mid-request disconnects replay deterministically from a
+seed.  Pass a fixed `port` to rehearse process death + restart: `stop()`
+then a fresh `FakeGenServer(port=same)` is a backend rejoining the fleet.
 """
 
 import asyncio
@@ -12,6 +18,8 @@ import threading
 from typing import List, Optional
 
 from aiohttp import web
+
+from areal_tpu.utils.faults import FaultPlan, apply_fault
 
 
 class FakeGenServer:
@@ -28,24 +36,38 @@ class FakeGenServer:
         completion: Optional[List[int]] = None,
         chunk_size: int = 1024,
         eos_token: Optional[int] = None,
+        port: int = 0,
+        fault_plan: Optional[FaultPlan] = None,
     ):
         self.completion = completion if completion is not None else list(range(100, 108))
         self.chunk_size = chunk_size
         self.eos_token = eos_token
+        self.fault_plan = fault_plan
         self.version = 0
         self.paused = False
         self.abort_once = False
         self.delay_s = 0.0  # holds /generate in flight (load-balancing tests)
         self.requests: List[dict] = []
         self.weight_updates: List[dict] = []
-        self.port: Optional[int] = None
+        self.port: Optional[int] = port or None
+        self._requested_port = port
         self._runner = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
 
+    async def _maybe_fault(self, request: web.Request, endpoint: str):
+        """Returns a faulted Response to serve instead of the real one, or
+        None to proceed (a `slow` fault has already delayed by now)."""
+        if self.fault_plan is None:
+            return None
+        return await apply_fault(self.fault_plan.decide(endpoint), request)
+
     # --- handlers ---
     async def _generate(self, request: web.Request):
+        faulted = await self._maybe_fault(request, "/generate")
+        if faulted is not None:
+            return faulted
         body = await request.json()
         self.requests.append(body)
         if self.delay_s:
@@ -88,20 +110,38 @@ class FakeGenServer:
         )
 
     async def _pause(self, request):
+        faulted = await self._maybe_fault(request, "/pause_generation")
+        if faulted is not None:
+            return faulted
         self.paused = True
         return web.json_response({"ok": True})
 
     async def _resume(self, request):
+        faulted = await self._maybe_fault(request, "/continue_generation")
+        if faulted is not None:
+            return faulted
         self.paused = False
         return web.json_response({"ok": True})
 
     async def _update_weights_from_disk(self, request):
+        faulted = await self._maybe_fault(request, "/update_weights_from_disk")
+        if faulted is not None:
+            return faulted
         body = await request.json()
         self.weight_updates.append(body)
-        self.version += 1
+        # a publish that names its version is authoritative (the router's
+        # rejoin force-reload stamps the fleet version); legacy publishes
+        # without one just advance
+        if body.get("version") is not None:
+            self.version = int(body["version"])
+        else:
+            self.version += 1
         return web.json_response({"ok": True, "version": self.version})
 
     async def _health(self, request):
+        faulted = await self._maybe_fault(request, "/health")
+        if faulted is not None:
+            return faulted
         return web.json_response({"status": "ok", "version": self.version})
 
     # --- lifecycle ---
@@ -120,9 +160,12 @@ class FakeGenServer:
             asyncio.set_event_loop(self._loop)
 
             async def _serve():
-                runner = web.AppRunner(self._make_app())
+                # short shutdown grace: a chaos-killed fleet member must die
+                # abruptly (keep-alive connections from router/client
+                # sessions would otherwise hold cleanup for 60 s)
+                runner = web.AppRunner(self._make_app(), shutdown_timeout=0.5)
                 await runner.setup()
-                site = web.TCPSite(runner, "127.0.0.1", 0)
+                site = web.TCPSite(runner, "127.0.0.1", self._requested_port)
                 await site.start()
                 self.port = runner.addresses[0][1]
                 self._runner = runner

@@ -78,6 +78,29 @@ def abort_run(tmp_path_factory):
     return out, tdir
 
 
+@pytest.mark.slow
+def test_remote_chaos_smoke():
+    """ISSUE 11: the chaos instrument itself — a seeded FaultProxy between
+    client and server — must complete the run and report the replayable
+    injected-fault log plus the trajectory-loss fraction.  Slow-marked:
+    the fast kill-one-of-two chaos acceptance lives in test_chaos_e2e.py;
+    this proves the bench-side harness (CI chaos-smoke runs it too)."""
+    out = _run_bench(["--publish-mode", "live",
+                      "--prompt-len", "32",
+                      "--chaos", "--chaos-seed", "5", "--chaos-rate", "0.3"])
+    chaos = out["chaos"]
+    assert chaos["seed"] == 5
+    assert chaos["plan_size"] > 0
+    assert chaos["injected"], "rate=0.3 must inject on an exercised call"
+    # every injected record is (endpoint, call_index, kind)
+    assert all(ep.startswith("/") and isinstance(i, int) and kind
+               for ep, i, kind in chaos["injected"])
+    assert 0.0 <= chaos["trajectory_loss_fraction"] <= 1.0
+    # goodput under fire: the run still made progress
+    assert out["async"]["trajectories"] > 0
+    assert out["async"]["trajs_per_sec_per_chip"] > 0
+
+
 def test_remote_abort_publish_gsm8k_synth_smoke(abort_run):
     out, _ = abort_run
     assert out["publish_mode"] == "abort"

@@ -1,0 +1,202 @@
+"""Chaos e2e (ISSUE 11 acceptance): a two-member rollout fleet behind the
+router; one member is killed mid-run.  The run must complete with every
+trajectory consumed or explicitly accounted lost, `resubmit` spans joining
+the original trace_ids, the staleness ledger settled, and — after a
+fixed-port restart — the rejoined backend force-reloaded to the fleet's
+published weight version before taking placements again."""
+
+import threading
+import time
+
+import pytest
+
+from areal_tpu.api.config import GenerationHyperparameters, InferenceEngineConfig
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.gen.router import Router, RouterConfig
+from areal_tpu.utils import telemetry
+from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+from tests.fake_server import FakeGenServer
+from tests.test_router import RouterHarness, _get, _post
+
+
+@pytest.fixture()
+def enabled_telemetry():
+    was = telemetry.is_enabled()
+    telemetry.set_enabled(True)
+    telemetry.EVENTS.clear()
+    yield
+    telemetry.set_enabled(was)
+    telemetry.EVENTS.clear()
+
+
+def _reward(prompt, completion, prompt_ids, completion_ids, **kw):
+    return float(len(completion_ids))
+
+
+def test_kill_one_of_two_mid_run_completes_and_rejoins(enabled_telemetry):
+    completion = list(range(100, 108))
+    servers = [FakeGenServer(completion=completion, chunk_size=2)
+               for _ in range(2)]
+    for s in servers:
+        s.delay_s = 0.05  # keep chunks in flight so the kill lands mid-run
+    addrs = [s.start() for s in servers]
+    router = Router(
+        RouterConfig(
+            schedule_policy="round_robin",
+            health_check_interval=0.1,
+            health_failure_threshold=1,
+            health_probe_timeout=0.5,
+        ),
+        addresses=addrs,
+    )
+    h = RouterHarness(router)
+    raddr = h.start()
+    eng = RemoteJaxEngine(InferenceEngineConfig(
+        experiment_name="chaos", trial_name="t", consumer_batch_size=8,
+        max_concurrent_rollouts=8, request_timeout=10, request_retries=2,
+        failover_retries=8,
+    ))
+    eng.initialize(addr=raddr)
+
+    def _assassin():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not servers[0].requests:
+            time.sleep(0.005)
+        servers[0].stop()
+
+    killer = threading.Thread(target=_assassin)
+    killer.start()
+    restarted = None
+    try:
+        wf = RLVRWorkflow(
+            reward_fn=_reward,
+            gconfig=GenerationHyperparameters(max_new_tokens=16),
+        )
+        batch = eng.rollout_batch(
+            [{"input_ids": [i]} for i in range(8)], workflow=wf
+        )
+        killer.join(timeout=10)
+
+        # 1. every trajectory consumed or explicitly accounted lost
+        n_out = batch["input_ids"].shape[0]
+        assert n_out + eng.executor.lost_trajectories == 8
+        assert eng.executor.lost_trajectories == 0, (
+            "failover must save every trajectory while one replica survives"
+        )
+
+        # 2. resubmit spans join the ORIGINAL trace ids (one trajectory
+        # surviving a server death, not N fresh submits)
+        events = telemetry.EVENTS.snapshot()
+        submits = {e["trace_id"] for e in events
+                   if e["event"] == "rollout_submit"}
+        resubmits = [e for e in events if e["event"] == "resubmit"]
+        assert resubmits, "killing a loaded replica must trigger resubmits"
+        assert all(e["trace_id"] in submits for e in resubmits)
+
+        # 3. staleness ledger settled: capacity returns to the churn invariant
+        stats = eng.executor.staleness_manager.get_stats()
+        assert stats.running == 0
+        assert stats.submitted == stats.accepted + stats.rejected
+
+        # 4. the router detected the death: breaker open, failovers counted
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            m = _get(raddr, "/metrics")
+            if m["backend_states"].get(addrs[0], {}).get("state") == "open":
+                break
+            time.sleep(0.05)
+        m = _get(raddr, "/metrics")
+        assert m["backend_states"][addrs[0]]["state"] == "open"
+        assert m["backend_states"][addrs[1]]["state"] == "closed"
+        assert m["failovers"] >= 1
+
+        # 5. degraded-mode publish: the survivor updates, the dead member is
+        # skipped and counted — the publish must not wedge behind the corpse
+        s, out = _post(raddr, "/update_weights",
+                       {"path": "/tmp/chaos_ck/v3", "version": 3})
+        assert s == 200 and out["version"] == 3
+        assert servers[1].weight_updates[-1]["version"] == 3
+        assert not servers[0].weight_updates
+        m = _get(raddr, "/metrics")
+        assert m["publish_partial_failures"] >= 1
+
+        # 6. fixed-port restart: the rejoin path must force-reload the stale
+        # member to the fleet version before re-admitting it to placement
+        restarted = FakeGenServer(completion=completion, chunk_size=2,
+                                  port=servers[0].port)
+        restarted.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                if _get(raddr, "/health")["status"] == "ok":
+                    break
+            except Exception:  # 503 while still degraded
+                pass
+            time.sleep(0.05)
+        health = _get(raddr, "/health")
+        assert health["status"] == "ok"
+        assert all(s["state"] == "closed" for s in health["servers"].values())
+        # final weight version agrees across surviving + rejoined fleet
+        assert restarted.version == 3
+        assert restarted.weight_updates[-1] == {"path": "/tmp/chaos_ck/v3",
+                                                "version": 3}
+        assert servers[1].version == 3
+    finally:
+        eng.destroy()
+        h.stop()
+        servers[1].stop()
+        if restarted is not None:
+            restarted.stop()
+
+
+def test_stale_rejoin_is_gated_until_reload_succeeds(enabled_telemetry):
+    """A backend that answers probes but cannot be brought to the fleet
+    version (its reload endpoint fails) must stay OUT of placement —
+    half-open/open, never closed — so stale weights cannot leak into a
+    batch."""
+    from areal_tpu.utils.faults import Fault, FaultPlan
+
+    healthy = FakeGenServer(completion=[100, 101])
+    # the flaky member fails every /update_weights_from_disk call, so the
+    # rejoin force-reload can never succeed
+    plan = FaultPlan({("/update_weights_from_disk", i): Fault("http_500")
+                      for i in range(64)})
+    flaky = FakeGenServer(completion=[100, 101], fault_plan=plan)
+    addrs = [healthy.start(), flaky.start()]
+    router = Router(
+        RouterConfig(
+            schedule_policy="round_robin",
+            health_check_interval=0.1,
+            health_failure_threshold=1,
+            health_probe_timeout=0.5,
+        ),
+        addresses=addrs,
+    )
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # publish v2: flaky's update endpoint 500s -> partial publish,
+        # breaker trips it open
+        s, out = _post(raddr, "/update_weights",
+                       {"path": "/tmp/ck/v2", "version": 2})
+        assert s == 200 and out["version"] == 2
+        assert healthy.weight_updates[-1]["version"] == 2
+
+        # probes keep answering (its /health is fine) so it cycles
+        # open -> half_open -> rejoin reload fails -> open; it must never
+        # reach closed, and placements must all land on the healthy member
+        time.sleep(0.5)
+        for i in range(4):
+            s, out2 = _post(raddr, "/generate", {
+                "rid": f"r{i}", "input_ids": [1],
+                "sampling_params": {"max_new_tokens": 4},
+            })
+            assert s == 200 and out2["output_tokens"]
+        assert not flaky.requests
+        m = _get(raddr, "/metrics")
+        assert m["backend_states"][addrs[1]]["state"] in ("open", "half_open")
+    finally:
+        h.stop()
+        healthy.stop()
+        flaky.stop()

@@ -1,0 +1,160 @@
+"""Deterministic fault injection (ISSUE 11): seeded plan generation,
+replay, and faults flowing through the fake server into the client's
+failover path.  The chaos-harness contract is that one integer (the seed)
+reproduces the exact injected-failure sequence."""
+
+import asyncio
+
+import pytest
+
+from areal_tpu.api.config import GenerationHyperparameters, InferenceEngineConfig
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.engine.jax_remote import RemoteJaxEngine
+from areal_tpu.utils.faults import Fault, FaultPlan
+
+from tests.fake_server import FakeGenServer
+
+
+# ---------------------------------------------------------------------------
+# plan determinism
+# ---------------------------------------------------------------------------
+
+
+def test_plan_generation_is_seed_deterministic():
+    a = FaultPlan.generate(seed=7)
+    b = FaultPlan.generate(seed=7)
+    assert a.to_dict() == b.to_dict()
+    assert a.plan, "default rate over 64 calls must plan at least one fault"
+    assert FaultPlan.generate(seed=8).to_dict() != a.to_dict()
+
+
+def test_decide_replay_matches_injected_log():
+    plan = FaultPlan.generate(seed=3, n_calls=32, rate=0.4)
+    seq = ["/generate"] * 32 + ["/health"] * 4
+    first = [plan.decide(ep) for ep in seq]
+    log1 = plan.injected_log()
+    assert log1, "rate=0.4 over 32 calls must inject"
+    plan.reset_counters()
+    assert [plan.decide(ep) for ep in seq] == first
+    assert plan.injected_log() == log1
+
+
+def test_plan_dict_roundtrip():
+    plan = FaultPlan.generate(
+        seed=5, n_calls=32, rate=0.5, kinds=("slow", "hang"), slow_s=0.2
+    )
+    clone = FaultPlan.from_dict(plan.to_dict())
+    assert clone.plan == plan.plan
+    assert FaultPlan.from_dict({}).plan == {}
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        Fault("segfault")
+
+
+# ---------------------------------------------------------------------------
+# injection through the fake server -> client failover
+# ---------------------------------------------------------------------------
+
+
+def _engine(addrs, **kw):
+    cfg = InferenceEngineConfig(
+        experiment_name="e", trial_name="t", consumer_batch_size=2,
+        max_concurrent_rollouts=16, request_timeout=10, request_retries=2,
+        **kw,
+    )
+    eng = RemoteJaxEngine(cfg)
+    eng.initialize(addr=addrs)
+    return eng
+
+
+@pytest.mark.parametrize("kind", ["http_500", "disconnect"])
+def test_injected_fault_drives_failover(kind):
+    """An injected backend fault on the first /generate call must push the
+    trajectory through the client's failover path and still complete on
+    the healthy replica."""
+    plan = FaultPlan({("/generate", 0): Fault(kind)})
+    faulty = FakeGenServer(completion=list(range(100, 106)), fault_plan=plan)
+    healthy = FakeGenServer(completion=list(range(100, 106)))
+    addrs = [faulty.start(), healthy.start()]
+    eng = _engine(addrs)  # round_robin: first rid places on the faulty server
+    try:
+        resp = asyncio.run(eng.agenerate(ModelRequest(
+            rid="r0", input_ids=[1, 2],
+            gconfig=GenerationHyperparameters(max_new_tokens=16),
+        )))
+        assert resp.output_tokens == list(range(100, 106))
+        assert resp.stop_reason == "stop"
+        assert plan.injected_log() == [("/generate", 0, kind)]
+        assert healthy.requests, "failover must reach the healthy replica"
+    finally:
+        eng.destroy()
+        faulty.stop()
+        healthy.stop()
+
+
+def test_slow_fault_passes_through():
+    plan = FaultPlan({("/generate", 0): Fault("slow", delay_s=0.05)})
+    server = FakeGenServer(completion=[100, 101], fault_plan=plan)
+    addr = server.start()
+    eng = _engine([addr])
+    try:
+        resp = asyncio.run(eng.agenerate(ModelRequest(
+            rid="r0", input_ids=[1],
+            gconfig=GenerationHyperparameters(max_new_tokens=8),
+        )))
+        assert resp.output_tokens == [100, 101]
+        assert plan.injected_log() == [("/generate", 0, "slow")]
+    finally:
+        eng.destroy()
+        server.stop()
+
+
+def test_seeded_chaos_run_replays_identically():
+    """End-to-end determinism (acceptance criterion): two fresh runs with
+    the same seed and the same single-threaded call sequence produce the
+    SAME injected-failure log — what makes a CI chaos failure reproducible
+    locally from one integer."""
+
+    def run_once():
+        plan = FaultPlan.generate(
+            seed=11, n_calls=16, rate=0.5, kinds=("http_500",)
+        )
+        faulty = FakeGenServer(
+            completion=list(range(100, 104)), chunk_size=2, fault_plan=plan
+        )
+        healthy = FakeGenServer(completion=list(range(100, 104)), chunk_size=2)
+        eng = _engine([faulty.start(), healthy.start()], failover_retries=8)
+        try:
+            for i in range(4):
+                resp = asyncio.run(eng.agenerate(ModelRequest(
+                    rid=f"r{i}", input_ids=[1],
+                    gconfig=GenerationHyperparameters(max_new_tokens=8),
+                )))
+                assert resp.output_tokens == list(range(100, 104))
+            return plan.injected_log()
+        finally:
+            eng.destroy()
+            faulty.stop()
+            healthy.stop()
+
+    first = run_once()
+    assert first, "seed 11 at rate=0.5 must inject on the exercised calls"
+    assert run_once() == first
+
+
+def test_kill_process_sigkills_and_reaps():
+    """kill_process is the one fault the in-process injector cannot
+    express: SIGKILL with no flush, exactly like an OOM-killed fleet
+    member.  It must reap the child (no zombie) and report the signal."""
+    import subprocess
+    import sys
+
+    from areal_tpu.utils.faults import kill_process
+
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    rc = kill_process(proc, timeout=10)
+    assert rc == -9
+    assert proc.poll() == -9  # reaped, not a zombie

@@ -177,6 +177,80 @@ def test_discovery_via_name_resolve(server):
         eng.destroy()
 
 
+def test_failover_resubmits_to_surviving_server():
+    """ISSUE 11: kill one of two backends mid-chunked-generation.  The
+    client must resubmit the accumulated tokens to the survivor (the same
+    resume contract as interruption) and the trajectory completes — with a
+    `resubmit` telemetry span joining the ORIGINAL trace_id, not a fresh
+    submit."""
+    import threading
+    import time as _time
+
+    from areal_tpu.utils import telemetry
+
+    s0 = FakeGenServer(completion=list(range(100, 110)), chunk_size=3)
+    s1 = FakeGenServer(completion=list(range(100, 110)), chunk_size=3)
+    s0.delay_s = 0.05  # keep chunks in flight long enough to die mid-run
+    addrs = [s0.start(), s1.start()]
+    eng = _engine(addrs, request_retries=2)
+    was = telemetry.is_enabled()
+    telemetry.set_enabled(True)
+    telemetry.EVENTS.clear()
+
+    def _assassin():
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and not s0.requests:
+            _time.sleep(0.005)
+        s0.stop()
+
+    killer = threading.Thread(target=_assassin)
+    killer.start()
+    try:
+        # round_robin places the first rid on s0
+        resp = _agen(eng, ModelRequest(
+            rid="victim", input_ids=[1, 2],
+            gconfig=GenerationHyperparameters(max_new_tokens=64),
+        ))
+        killer.join(timeout=10)
+        assert resp.output_tokens == list(range(100, 110))
+        assert resp.stop_reason == "stop"
+        # the survivor resumed from the accumulated prompt and finished
+        assert s1.requests
+        assert s1.requests[-1]["input_ids"][:2] == [1, 2]
+        assert 100 in s1.requests[-1]["input_ids"]
+        events = telemetry.EVENTS.snapshot()
+        submit = next(e for e in events if e["event"] == "rollout_submit")
+        resubmits = [e for e in events if e["event"] == "resubmit"]
+        assert resubmits, "failover must emit a resubmit span"
+        assert all(e["trace_id"] == submit["trace_id"] for e in resubmits)
+        assert all(e["to_server"] == addrs[1] for e in resubmits)
+    finally:
+        telemetry.set_enabled(was)
+        telemetry.EVENTS.clear()
+        eng.destroy()
+        s1.stop()
+
+
+def test_trajectory_lost_after_failover_budget():
+    """With every server dead and the failover budget exhausted, agenerate
+    must raise TrajectoryLostError (the executor's expected fleet-failure
+    outcome) rather than an opaque transport error."""
+    from areal_tpu.core.executor import TrajectoryLostError
+
+    s = FakeGenServer(completion=[100])
+    addr = s.start()
+    s.stop()  # dead before the first request: connection refused
+    eng = _engine(addr, request_retries=1, failover_retries=2)
+    try:
+        with pytest.raises(TrajectoryLostError):
+            _agen(eng, ModelRequest(
+                rid="doomed", input_ids=[1],
+                gconfig=GenerationHyperparameters(max_new_tokens=4),
+            ))
+    finally:
+        eng.destroy()
+
+
 def _reward_len(prompt, completion, prompt_ids, completion_ids, **kwargs):
     return float(len(completion_ids))
 

@@ -422,3 +422,153 @@ def test_fleet_gate_two_clients_share_one_budget(fleet, monkeypatch):
         for c in clients:
             c.destroy()
         h.stop()
+
+
+def test_lease_ttl_expiry_replaces_and_rejects_late_finish(fleet):
+    """ISSUE 11 satellite: a lease past alloc_ttl is reclaimed (its slot
+    re-placeable) and the original client's LATE /finish_request is
+    rejected as expired — counting it would double-book the admission
+    budget against whoever now holds the slot."""
+    import time as _time
+
+    _, addrs = fleet
+    cfg = RouterConfig(
+        train_batch_size=1, max_head_offpolicyness=0, alloc_ttl=0.2
+    )
+    router = Router(cfg, addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        # budget (0 + 0 + 1) * 1 = 1: one admission fleet-wide
+        s, r1 = _post(raddr, "/allocate_request", {"qid": "a"})
+        assert s == 200 and r1["alloc_id"]
+        s, _ = _post(raddr, "/allocate_request", {"qid": "b"},
+                     expect_status=409)
+        assert s == 409
+
+        _time.sleep(0.3)  # client "a" stalls past the TTL
+        s, r2 = _post(raddr, "/allocate_request", {"qid": "b"})
+        assert s == 200, "expired lease must be re-placeable"
+
+        # the stalled client finally answers: rejected, not double-counted
+        s, out = _post(raddr, "/finish_request",
+                       {"alloc_id": r1["alloc_id"], "accepted": True})
+        assert s == 200 and out == {"ok": False, "expired": True}
+        assert router._accepted == 0
+
+        s, out = _post(raddr, "/finish_request",
+                       {"alloc_id": r2["alloc_id"], "accepted": True})
+        assert s == 200 and out["ok"]
+        assert router._accepted == 1
+    finally:
+        h.stop()
+
+
+def test_health_cached_with_freshness_and_breaker_detection():
+    """ISSUE 11 satellite: /health serves the checker's CACHED state with a
+    freshness timestamp (no inline probe fanout per scrape), and the active
+    probe loop trips a dead backend open within
+    ~failure_threshold * interval."""
+    import time as _time
+
+    servers = [FakeGenServer(completion=[100, 101]) for _ in range(2)]
+    addrs = [s.start() for s in servers]
+    router = Router(
+        RouterConfig(
+            health_check_interval=0.1,
+            health_failure_threshold=2,
+            health_probe_timeout=0.5,
+        ),
+        addresses=addrs,
+    )
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        health = _get(raddr, "/health")
+        assert health["status"] == "ok"
+        assert set(health["servers"]) == set(addrs)
+        assert all(s["state"] == "closed" for s in health["servers"].values())
+        assert health["freshness_s"] is not None
+
+        servers[0].stop()
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            try:
+                _get(raddr, "/health")
+            except Exception:  # 503 once degraded
+                break
+            _time.sleep(0.05)
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            _get(raddr, "/health")
+        body = exc_info.value.read().decode()
+        import json as _json
+
+        health = _json.loads(body)
+        assert health["status"] == "degraded"
+        assert health["servers"][addrs[0]]["state"] == "open"
+        assert health["servers"][addrs[1]]["state"] == "closed"
+        # the cached view is fresh: the probe loop runs every 0.1s
+        assert health["freshness_s"] < 5.0
+
+        # new placements avoid the open backend entirely
+        for i in range(4):
+            s, out = _post(raddr, "/generate", {
+                "rid": f"post-death-{i}", "input_ids": [1],
+                "sampling_params": {"max_new_tokens": 4},
+            })
+            assert s == 200 and out["output_tokens"]
+        assert len(servers[0].requests) == 0
+
+        # the JSON metrics surface mirrors the breaker view (the Prometheus
+        # exposition of areal_router_backend_state is asserted in
+        # test_telemetry.py, which owns the shared ROUTER registry)
+        m = _get(raddr, "/metrics")
+        assert m["backend_states"][addrs[0]]["state"] == "open"
+    finally:
+        h.stop()
+        servers[1].stop()
+
+
+def test_drain_excludes_placement_but_keeps_fanout(fleet):
+    """Draining is graceful removal: no NEW placements, but the backend
+    still receives control-plane fanouts (final weight sync completes)."""
+    servers, addrs = fleet
+    router = Router(
+        RouterConfig(schedule_policy="round_robin", health_check_interval=0),
+        addresses=addrs,
+    )
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        s, _ = _post(raddr, "/drain", {"addr": addrs[0]})
+        assert s == 200
+        s, _ = _post(raddr, "/drain", {"addr": "10.0.0.1:1"},
+                     expect_status=404)
+        assert s == 404
+
+        for i in range(6):
+            s, out = _post(raddr, "/generate", {
+                "rid": f"r{i}", "input_ids": [1],
+                "sampling_params": {"max_new_tokens": 4},
+            })
+            assert s == 200 and out["output_tokens"]
+        counts = [len(s.requests) for s in servers]
+        assert counts == [0, 3, 3], counts
+
+        # fanouts still reach the draining backend
+        s, _ = _post(raddr, "/update_weights", {"path": "/dev/null/v1",
+                                                "version": 1})
+        assert s == 200
+        assert all(len(s.weight_updates) == 1 for s in servers)
+
+        s, _ = _post(raddr, "/undrain", {"addr": addrs[0]})
+        assert s == 200
+        _post(raddr, "/generate", {
+            "rid": "back", "input_ids": [1],
+            "sampling_params": {"max_new_tokens": 4},
+        })
+        assert len(servers[0].requests) == 1
+    finally:
+        h.stop()

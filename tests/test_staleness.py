@@ -91,3 +91,76 @@ def test_thread_safety():
     assert s.submitted == n * iters
     assert s.accepted == n * iters
     assert s.running == 0
+
+
+def test_ledger_invariant_catches_unbalanced_settle():
+    """ISSUE 11 satellite: every transition self-checks
+    submitted == accepted + rejected + running.  A double-settle (the
+    capacity-leak bug class that death paths can introduce) must fail
+    loudly AT the broken transition, not wedge admission much later."""
+    import pytest
+
+    m = StalenessManager(max_concurrent_rollouts=4, consumer_batch_size=2,
+                         max_staleness=0)
+    m.on_rollout_submitted()
+    m.on_rollout_accepted()
+    with pytest.raises(RuntimeError, match="staleness ledger violated"):
+        m.on_rollout_accepted()  # settling the same rollout twice
+
+
+def test_mid_flight_kill_settles_capacity():
+    """Regression (ISSUE 11): a backend killed mid-trajectory with the
+    failover budget exhausted must settle the staleness ledger through
+    the reject path — running returns to 0, the loss is counted, and
+    admission capacity fully recovers (no leaked slot)."""
+    import threading
+    import time as _time
+
+    import pytest
+
+    from areal_tpu.api.config import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+    )
+    from areal_tpu.engine.jax_remote import RemoteJaxEngine
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+    from tests.fake_server import FakeGenServer
+
+    server = FakeGenServer(completion=list(range(100, 106)), chunk_size=2)
+    server.delay_s = 0.05
+    addr = server.start()
+    cfg = InferenceEngineConfig(
+        experiment_name="e", trial_name="t", consumer_batch_size=2,
+        max_concurrent_rollouts=4, max_head_offpolicyness=0,
+        request_timeout=5, request_retries=1, failover_retries=1,
+    )
+    eng = RemoteJaxEngine(cfg)
+    eng.initialize(addr=addr)
+
+    def _assassin():
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and not server.requests:
+            _time.sleep(0.005)
+        server.stop()
+
+    killer = threading.Thread(target=_assassin)
+    killer.start()
+    try:
+        wf = RLVRWorkflow(
+            reward_fn=lambda *a, **k: 0.0,
+            gconfig=GenerationHyperparameters(max_new_tokens=16),
+        )
+        mgr = eng.executor.staleness_manager
+        cap0 = mgr.get_capacity(0)
+        eng.submit({"input_ids": [1, 2]}, workflow=wf)
+        with pytest.raises(TimeoutError):
+            eng.wait(1, timeout=5)  # the lone trajectory is lost, not batched
+        killer.join(timeout=10)
+        assert eng.executor.lost_trajectories == 1
+        stats = mgr.get_stats()
+        assert stats.submitted == 1
+        assert stats.rejected == 1
+        assert stats.running == 0
+        assert mgr.get_capacity(0) == cap0  # no leaked admission slot
+    finally:
+        eng.destroy()

@@ -431,6 +431,51 @@ def test_metrics_schema_pinned(gen_server, router_addr, enabled):
         assert all(n.startswith("areal_") for n in served)
 
 
+def test_router_backend_state_gauge_tracks_breaker():
+    """ISSUE 11: areal_router_backend_state must expose the circuit-breaker
+    code per backend (0=closed, 2=open) so dashboards can see a dead fleet
+    member.  Runs after the exposition test above: a labeled scrape leaves
+    per-server samples in the shared ROUTER registry, which would skew that
+    test's exact-sum assertions if scraped earlier."""
+    import time
+
+    from areal_tpu.gen.router import Router, RouterConfig
+
+    from tests.fake_server import FakeGenServer
+    from tests.test_router import RouterHarness
+
+    backends = [FakeGenServer(completion=[1, 2]) for _ in range(2)]
+    addrs = [s.start() for s in backends]
+    router = Router(
+        RouterConfig(
+            schedule_policy="round_robin",
+            health_check_interval=0.1,
+            health_failure_threshold=1,
+            health_probe_timeout=0.5,
+        ),
+        addresses=addrs,
+    )
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        backends[0].stop()
+        deadline = time.monotonic() + 10
+        text = ""
+        while time.monotonic() < deadline:
+            text = _scrape(raddr)
+            if f'areal_router_backend_state{{server="{addrs[0]}"}} 2' in text:
+                break
+            time.sleep(0.05)
+        assert f'areal_router_backend_state{{server="{addrs[0]}"}} 2' in text
+        assert f'areal_router_backend_state{{server="{addrs[1]}"}} 0' in text
+        parsed = parse_prometheus_text(text)
+        assert "areal_router_failovers_total" in parsed
+        assert "areal_publish_partial_failures_total" in parsed
+    finally:
+        h.stop()
+        backends[1].stop()
+
+
 # ---------------------------------------------------------------------------
 # lifecycle events through the live server
 # ---------------------------------------------------------------------------
