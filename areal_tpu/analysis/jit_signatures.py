@@ -100,6 +100,11 @@ def compute_budgets(config: Dict[str, int]) -> Dict[str, int]:
         "suffix_prefill": rows * ladder * (ladder + 1) * 2,
         # migration/fan-out copy: pow2 rows x bucketed block
         "kv_copy": rows * ladder,
+        # speculative verify (ISSUE 12): per tier x key bucket x nonzero
+        # draft-length rung (D=0 reuses the decode program, so only the
+        # nonzero rungs of the spec ladder mint verify signatures);
+        # spec_rungs=0 (spec decode off) budgets zero verify programs
+        "verify": tiers * ladder * config.get("spec_rungs", 0),
     }
 
 
@@ -147,6 +152,10 @@ def render_budget_doc(reference_configs: Dict[str, Dict[str, int]]) -> Dict:
             "prefill": "rows(n_slots) * ladder * 2",
             "suffix_prefill": "rows(n_slots) * ladder * (ladder + 1) * 2",
             "kv_copy": "rows(n_slots) * ladder",
+            "verify": (
+                "decode_tiers * ladder * spec_rungs  (nonzero draft-length"
+                " rungs of the spec ladder; 0 when spec decode is off)"
+            ),
         },
         "reference_configs": {
             name: {"config": cfg, "budgets": compute_budgets(cfg)}

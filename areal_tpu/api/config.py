@@ -260,6 +260,15 @@ class GenServerConfig:
     decode_tiers: int = 1
     decode_tier_lens: List[int] = field(default_factory=list)
     decode_tier_slots: List[int] = field(default_factory=list)
+    # Self-speculative decoding (ISSUE 12): prompt-lookup drafts verified in
+    # one dispatch per tier; the emitted streams are bit-identical to plain
+    # decode at any temperature (counter-keyed sampling), so this is purely
+    # a throughput knob.  spec_ladder lists the static draft-length rungs
+    # (must match the checked-in signature budget's spec_rungs accounting);
+    # spec_draft_len > 0 pins D instead of adapting.
+    spec_decode: bool = False
+    spec_ladder: List[int] = field(default_factory=list)
+    spec_draft_len: int = 0
 
     @staticmethod
     def build_cmd(
@@ -293,6 +302,15 @@ class GenServerConfig:
                 "--decode-tier-slots="
                 + ",".join(str(x) for x in config.decode_tier_slots)
             )
+        if config.spec_decode:
+            args.append("--spec-decode")
+            if config.spec_ladder:
+                args.append(
+                    "--spec-ladder="
+                    + ",".join(str(x) for x in config.spec_ladder)
+                )
+            if config.spec_draft_len:
+                args.append(f"--spec-draft-len={config.spec_draft_len}")
         if port:
             args.append(f"--port={port}")
         return " ".join(args)

@@ -102,12 +102,18 @@ from jax.sharding import PartitionSpec as P
 from areal_tpu.analysis.lockcheck import lock_guarded
 
 from areal_tpu.gen.sampling import sample_tokens, sample_tokens_keyed
+from areal_tpu.gen.spec import (
+    DEFAULT_SPEC_LADDER,
+    SpecController,
+    propose_draft,
+)
 from areal_tpu.models.model_config import TransformerConfig
 from areal_tpu.ops.kv_copy import copy_kv_prefix
 from areal_tpu.models.transformer import (
     forward_decode,
     forward_prefill,
     forward_prefill_cached,
+    forward_verify,
     init_kv_cache,
     init_params,
     param_partition_specs,
@@ -270,6 +276,14 @@ class GenEngine:
         decode_tiers: int = 1,
         decode_tier_lens: Optional[List[int]] = None,
         decode_tier_slots: Optional[List[int]] = None,
+        spec_decode: bool = False,
+        spec_ladder: Optional[List[int]] = None,
+        spec_draft_len: Optional[int] = None,
+        spec_ngram_max: int = 3,
+        spec_ngram_min: int = 1,
+        spec_probe_every: int = 8,
+        spec_accept_hi: float = 0.5,
+        spec_accept_lo: float = 0.2,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -460,6 +474,38 @@ class GenEngine:
         # device->device with zero uploads
         self._dev_state: Optional[Dict[str, jax.Array]] = None
         self._state_dirty = True
+        # --- self-speculative decode (ISSUE 12) ------------------------
+        # Prompt-lookup drafting + one-dispatch verification.  D rides a
+        # small STATIC ladder (each nonzero rung is its own verify program
+        # per (tier, K) — budgeted in analysis/signature_budget.json);
+        # spec_draft_len pins D for benches/tests, otherwise the
+        # per-tier acceptance-rate controller adapts along the ladder.
+        # Correctness never depends on any of this: verification samples
+        # every position under the SAME counter-keyed PRNG plain decode
+        # would use, so the output stream is bit-identical for any D.
+        self.spec_decode = spec_decode
+        if spec_draft_len is not None:
+            if spec_draft_len <= 0:
+                raise ValueError("spec_draft_len must be positive")
+            self.spec_ladder = (0, int(spec_draft_len))
+        else:
+            self.spec_ladder = tuple(
+                sorted(set(int(d) for d in (spec_ladder or DEFAULT_SPEC_LADDER)))
+            )
+        self.spec_draft_len = spec_draft_len
+        self.spec_ngram_max = spec_ngram_max
+        self.spec_ngram_min = spec_ngram_min
+        self._spec = SpecController(
+            ladder=self.spec_ladder,
+            accept_hi=spec_accept_hi,
+            accept_lo=spec_accept_lo,
+            probe_every=spec_probe_every,
+        )
+        self._spec_max_d = max(self.spec_ladder)
+        # per-tier D chosen for the CURRENT step — a self attr so the
+        # dispatch site's static arg is provably on the configured ladder
+        # (areal-lint C6 value lattice: self.<attr> is engine config)
+        self._spec_tier_d: Dict[int, int] = {}
         # weight version of the OLDEST K/V in each slot's valid prefix:
         # retained and shared prefixes propagate it, so strict-version
         # audits can prove no pre-swap KV seeds post-swap decoding
@@ -490,6 +536,14 @@ class GenEngine:
             # host->device re-uploads of the decode state (dirtied by
             # admission/free/migration); steady state adds none
             "state_syncs": 0,
+            # speculative decode (ISSUE 12): draft tokens proposed /
+            # accepted (their ratio is the acceptance rate steering the
+            # per-tier D ladder) and verify dispatches issued.  The server
+            # telemetry mirror exports these as
+            # areal_gen_spec_drafted_total / areal_gen_spec_accepted_total.
+            "spec_drafted": 0,
+            "spec_accepted": 0,
+            "verify_calls": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -567,6 +621,104 @@ class GenEngine:
             out = jnp.stack([toks.astype(jnp.float32), logps])  # [2, n, size]
             return out, cache, tokens, lengths, rope_pos
 
+        def _verify_chunk(
+            params, cache, tokens, lengths, rope_pos, streams, active,
+            temp, tp, tk, decode_key, drafts, draft_lens,
+            base, size, key_window, d_max,
+        ):
+            """Speculative step for ONE tier: score the pending token plus
+            up to `d_max` prompt-lookup drafts per slot in a single
+            `forward_verify` dispatch, sample every position under the
+            SAME counter-keyed PRNG plain decode would use, and accept the
+            leading run of drafts that match what the sampler emits — so
+            the delivered stream is bit-identical to non-speculative
+            decode at any temperature.  Per-slot state (lengths/rope/last
+            token) advances by the accepted count ON DEVICE; rejected
+            draft positions get their freshly-written K/V zeroed before
+            the dispatch returns, so no rejected write outlives it."""
+            Dp1 = d_max + 1
+            tok_b = jax.lax.slice_in_dim(tokens, base, base + size)
+            len_b = jax.lax.slice_in_dim(lengths, base, base + size)
+            rp_b = jax.lax.slice_in_dim(rope_pos, base, base + size)
+            act_b = jax.lax.slice_in_dim(active, base, base + size)
+            temp_b = jax.lax.slice_in_dim(temp, base, base + size)
+            tp_b = jax.lax.slice_in_dim(tp, base, base + size)
+            tk_b = jax.lax.slice_in_dim(tk, base, base + size)
+            st_b = jax.lax.slice_in_dim(streams, base, base + size)
+            inputs = jnp.concatenate([tok_b[:, None], drafts], axis=1)
+            n_write = draft_lens + 1  # pending token + real draft positions
+            logits, cache = forward_verify(
+                params, cfg, inputs, len_b, cache,
+                rope_positions=rp_b, key_window=key_window,
+                slot_base=base, active=act_b, n_write=n_write,
+            )  # [size, Dp1, V]
+            # position-keyed sampling: logits[:, j] is the distribution at
+            # sequence position len + j, exactly the row a plain decode
+            # step would sample with key fold(fold(decode_key, stream),
+            # len + j) — flattening to [size*Dp1] preserves per-row
+            # determinism (sample_tokens_keyed is fully row-vmapped)
+            slot_keys = jax.vmap(
+                lambda s: jax.random.fold_in(decode_key, s)
+            )(st_b)
+            offs = jnp.arange(Dp1, dtype=jnp.int32)
+            pos = len_b[:, None] + offs[None, :]  # [size, Dp1]
+            keys = jax.vmap(
+                jax.vmap(jax.random.fold_in, in_axes=(None, 0))
+            )(slot_keys, pos)
+            V = logits.shape[-1]
+            tok_f, logp_f = sample_tokens_keyed(
+                logits.astype(jnp.float32).reshape(size * Dp1, V),
+                keys.reshape(size * Dp1, *keys.shape[2:]),
+                jnp.repeat(temp_b, Dp1),
+                jnp.repeat(tk_b, Dp1),
+                jnp.repeat(tp_b, Dp1),
+            )
+            sampled = tok_f.reshape(size, Dp1)
+            logp = logp_f.reshape(size, Dp1)
+            # accept the leading run where the draft IS what the sampler
+            # emitted; the first mismatch position already carries the
+            # correct (non-speculative) token, so a+1 tokens always emit
+            ok = (sampled[:, :d_max] == drafts) & (
+                offs[None, :d_max] < draft_lens[:, None]
+            )
+            a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+            n_emit = jnp.where(act_b, a + 1, 0)
+            # rejected-draft K/V must not outlive this dispatch: zero the
+            # cache rows at positions above the accepted frontier (index M
+            # scatter-drops everything else) — the decode-side analogue of
+            # the idle-slot write clamp, made auditable by tests
+            M_cache = cache["k"].shape[2]
+            rej = (offs[None, :] >= n_emit[:, None]) & (
+                offs[None, :] < n_write[:, None]
+            ) & act_b[:, None]
+            rej_idx = jnp.where(rej, pos, M_cache)
+            slots = base + jnp.arange(size)
+            cache = {
+                "k": cache["k"].at[:, slots[:, None], rej_idx].set(
+                    0, mode="drop"
+                ),
+                "v": cache["v"].at[:, slots[:, None], rej_idx].set(
+                    0, mode="drop"
+                ),
+            }
+            # advance the device-resident state by the accepted count
+            new_tok = jnp.where(
+                act_b, jnp.take_along_axis(sampled, a[:, None], 1)[:, 0],
+                tok_b,
+            )
+            tokens = jax.lax.dynamic_update_slice_in_dim(
+                tokens, new_tok, base, 0
+            )
+            lengths = jax.lax.dynamic_update_slice_in_dim(
+                lengths, len_b + n_emit, base, 0
+            )
+            rope_pos = jax.lax.dynamic_update_slice_in_dim(
+                rope_pos, rp_b + n_emit, base, 0
+            )
+            # decode-layout download: [2, Dp1, size] + per-slot emit count
+            out = jnp.stack([sampled.T.astype(jnp.float32), logp.T])
+            return out, n_emit, cache, tokens, lengths, rope_pos
+
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
         # the suffix program carries the cross-slot prefix fan-out fused in
         # (ops/kv_copy.py gather/scatter before the layer scan): copy_block
@@ -581,6 +733,14 @@ class GenEngine:
         # ladder, so steady state compiles O(tiers x log(M/quantum))
         # programs and then mints none (pinned by test)
         self._decode_fn = jax.jit(_decode_chunk, static_argnums=(11, 12, 13, 14),
+                                  donate_argnums=(1, 2, 3, 4))
+        # verify signature family: (tier block, K bucket, D rung) — D
+        # rides the small static spec ladder (D=0 reuses the decode
+        # program outright), so spec decode adds
+        # tiers x ladder x |nonzero rungs| programs at most, budgeted in
+        # analysis/signature_budget.json ("verify") and pinned by the
+        # jit-cache soak tests
+        self._verify_fn = jax.jit(_verify_chunk, static_argnums=(13, 14, 15, 16),
                                   donate_argnums=(1, 2, 3, 4))
         # tier migration: batched device-side cache-row copy (the group
         # fan-out machinery reused verbatim); block is bucketed
@@ -1766,6 +1926,14 @@ class GenEngine:
                 for t in range(self.n_tiers)
             ]
 
+    def spec_acceptance_rates(self) -> List[float]:
+        """Windowed per-tier draft acceptance rate steering the D ladder
+        (metrics surface; 0.0 before any verify dispatch has reported)."""
+        return [
+            self._spec.acceptance_rate(t) or 0.0
+            for t in range(self.n_tiers)
+        ]
+
     def decode_attended_fraction(self) -> float:
         """Attended span / configured ceiling over all decode dispatches:
         1.0 means decode paid the full `max_seq_len` width (the pre-window
@@ -1897,7 +2065,11 @@ class GenEngine:
         otherwise pay O(slots x chunk) interpreter overhead per step)."""
         self._admit()
         n = chunk or self.decode_chunk
-        self._plan_migrations(n)
+        # a verify dispatch can advance a slot by up to D+1 tokens in one
+        # step — migration planning must see the larger overshoot
+        self._plan_migrations(
+            max(n, self._spec_max_d + 1) if self.spec_decode else n
+        )
         with self._lock:
             active = [s for s in range(self.n_slots) if self.slot_req[s] is not None]
             if not active:
@@ -1915,6 +2087,51 @@ class GenEngine:
         for s in active:
             tier_active[int(self.slot_tier[s])].append(s)
         M = self.max_seq_len
+        # prompt-lookup drafting (ISSUE 12): host-side n-gram match over
+        # each slot's accumulated tokens (seq_tokens holds the pending
+        # last token at index lengths[s]); per-tier D comes off the static
+        # ladder via the acceptance controller, or is pinned by
+        # spec_draft_len.  Drafts are capped by cache room and remaining
+        # token budget.  The chosen D parks in _spec_tier_d so the
+        # dispatch's static arg is a self attr (C6 on-ladder lattice).
+        spec_plan: Dict[int, tuple] = {}
+        if self.spec_decode:
+            self._spec_tier_d = {}
+            for t in range(self.n_tiers):
+                if not tier_active[t]:
+                    continue
+                d_t = (
+                    self.spec_draft_len
+                    if self.spec_draft_len is not None
+                    else self._spec.draft_len(t)
+                )
+                if d_t <= 0:
+                    continue
+                lo = self.tier_start[t]
+                drafts = np.zeros((self.tier_size[t], d_t), np.int32)
+                dlens = np.zeros(self.tier_size[t], np.int32)
+                for s in tier_active[t]:
+                    req = self.slot_req[s]
+                    if req is None:
+                        continue
+                    L = int(self.lengths[s])
+                    cap = min(
+                        d_t,
+                        self.max_seq_len - 2 - L,
+                        req.max_new_tokens - len(req.output_tokens) - 1,
+                    )
+                    if cap <= 0:
+                        continue
+                    d = propose_draft(
+                        self.seq_tokens[s, : L + 1], cap,
+                        self.spec_ngram_max, self.spec_ngram_min,
+                    )
+                    if d.size:
+                        drafts[s - lo, : d.size] = d
+                        dlens[s - lo] = d.size
+                if dlens.any():
+                    self._spec_tier_d[t] = d_t
+                    spec_plan[t] = (drafts, dlens)
         # decode-chunk telemetry is the one per-dispatch cost, so the whole
         # block (clock reads, trace-id snapshot) is gated on the flag
         tele = telemetry.is_enabled()
@@ -1930,10 +2147,59 @@ class GenEngine:
                 if tier_active[t]
             }
             t_dispatch = time.perf_counter()
-        dev_outs: List[tuple] = []  # (tier, device out) — fetch after all dispatch
+        # (tier, device out, device n_emit or None, out rows, draft lens)
+        dev_outs: List[tuple] = []
         try:
             for t in range(self.n_tiers):
                 if not tier_active[t]:
+                    continue
+                plan = spec_plan.get(t)
+                if plan is not None:
+                    # speculative step: pending token + D drafts verified
+                    # in ONE dispatch; state advances by accepted count on
+                    # device.  D=0 tiers fall through to the plain decode
+                    # program below — no degenerate verify signature.
+                    drafts, dlens = plan
+                    if self.decode_window:
+                        span = int(
+                            max(self.lengths[s] for s in tier_active[t])
+                        )
+                        key_window = round_up_to_bucket(
+                            span + self._spec_tier_d[t] + 1,
+                            self.prompt_bucket, M,
+                        )
+                    else:
+                        key_window = M
+                    out_t, nem_t, self.cache, tok, ln, rp = self._verify_fn(
+                        self.params,
+                        self.cache,
+                        st["tokens"],
+                        st["lengths"],
+                        st["rope_pos"],
+                        st["streams"],
+                        st["active"],
+                        st["temp"],
+                        st["top_p"],
+                        st["top_k"],
+                        self._decode_key,
+                        drafts,
+                        dlens,
+                        self.tier_start[t],
+                        self.tier_size[t],
+                        key_window,
+                        self._spec_tier_d[t],
+                    )
+                    st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
+                    rows = self._spec_tier_d[t] + 1
+                    self.stats["verify_calls"] += 1
+                    self.stats["spec_drafted"] += int(dlens.sum())
+                    self.stats["decode_attended_cols"] += (
+                        key_window * self.tier_size[t] * rows
+                    )
+                    self.stats["decode_ceiling_cols"] += (
+                        M * self.tier_size[t] * rows
+                    )
+                    dev_outs.append((t, out_t, nem_t, rows, dlens))
                     continue
                 if self.decode_window:
                     span = int(max(self.lengths[s] for s in tier_active[t]))
@@ -1967,32 +2233,61 @@ class GenEngine:
                 self.stats["decode_ceiling_cols"] += (
                     M * self.tier_size[t] * n
                 )
-                dev_outs.append((t, out_t))
+                dev_outs.append((t, out_t, None, n, None))
         except Exception:
             # a failed dispatch may have consumed (donated) device state
             with self._lock:
                 self._dev_state = None
                 self._state_dirty = True
             raise
-        toks = np.zeros((n, S), np.int32)
-        logps = np.zeros((n, S), np.float32)
-        for t, out_t in dev_outs:
+        nm = max(rows for _, _, _, rows, _ in dev_outs)
+        toks = np.zeros((nm, S), np.int32)
+        logps = np.zeros((nm, S), np.float32)
+        # per-slot usable token count: full chunk for decode tiers, the
+        # accepted-run length (>= 1: the corrected token always emits) for
+        # verify tiers — delivery masks everything beyond it
+        avail = np.zeros(S, np.int64)
+        for t, out_t, nem_t, rows, dlens in dev_outs:
             # areal-lint: disable=host-sync delivery point: ONE fused download per tier chunk is the designed host round-trip cadence
-            arr = np.asarray(out_t)  # [2, n, tier_size]
+            arr = np.asarray(out_t)  # [2, rows, tier_size]
             lo = self.tier_start[t]
-            toks[:, lo : lo + self.tier_size[t]] = arr[0].astype(np.int32)
-            logps[:, lo : lo + self.tier_size[t]] = arr[1]
+            hi = lo + self.tier_size[t]
+            toks[:rows, lo:hi] = arr[0].astype(np.int32)
+            logps[:rows, lo:hi] = arr[1]
+            if nem_t is None:
+                avail[lo:hi] = rows
+                drafted = accepted = 0
+            else:
+                # areal-lint: disable=host-sync delivery point: the accepted-count fetch rides the same per-tier delivery round-trip
+                nem = np.asarray(nem_t).astype(np.int64)
+                avail[lo:hi] = nem
+                drafted = int(dlens.sum())
+                accepted = int(np.maximum(nem - 1, 0).sum())
+                self.stats["spec_accepted"] += accepted
+                self._spec.record(t, drafted, accepted)
             if tele:
                 lat = time.perf_counter() - t_dispatch
                 telemetry.DECODE_CHUNK.observe(lat, tier=str(t))
-                telemetry.emit(
-                    "decode_chunk",
-                    tier=t,
-                    chunk=n,
-                    n_active=len(tier_active[t]),
-                    latency_s=lat,
-                    trace_ids=tier_trace.get(t, []),
-                )
+                if nem_t is None:
+                    telemetry.emit(
+                        "decode_chunk",
+                        tier=t,
+                        chunk=n,
+                        n_active=len(tier_active[t]),
+                        latency_s=lat,
+                        trace_ids=tier_trace.get(t, []),
+                    )
+                else:
+                    telemetry.emit(
+                        "spec_verify",
+                        tier=t,
+                        draft_len=rows - 1,
+                        drafted=drafted,
+                        accepted=accepted,
+                        n_active=len(tier_active[t]),
+                        latency_s=lat,
+                        trace_ids=tier_trace.get(t, []),
+                    )
 
         delivered = 0
         to_finish: List[tuple] = []
@@ -2010,29 +2305,33 @@ class GenEngine:
             A = np.asarray([s for s, _ in pairs])
             reqs = [r for _, r in pairs]
             a = len(pairs)
-            tk = toks[:, A]  # [n, a]
+            tk = toks[:, A]  # [nm, a]
             lp = logps[:, A]
+            av = avail[A]  # per-slot usable rows (ragged under spec decode)
             c0 = np.fromiter((len(r.output_tokens) for r in reqs), np.int64, a)
             max_new = np.fromiter((r.max_new_tokens for r in reqs), np.int64, a)
             min_new = np.fromiter((r.min_new_tokens for r in reqs), np.int64, a)
             eos = self.model_config.eos_token_id
-            stop = np.zeros((n, a), bool)
+            stop = np.zeros((nm, a), bool)
             for j, r in enumerate(reqs):
                 sids = r.stop_token_ids or ([eos] if eos is not None else [])
                 if sids:
                     stop[:, j] = np.isin(tk[:, j], sids)
-            steps = np.arange(1, n + 1, dtype=np.int64)[:, None]  # [n, 1]
+            steps = np.arange(1, nm + 1, dtype=np.int64)[:, None]  # [nm, 1]
+            # rows past a slot's avail are rejected-draft / pad garbage:
+            # they neither deliver nor trigger stop conditions
+            valid = steps <= av[None, :]
             out_count = c0[None, :] + steps
-            hit_stop = stop & (out_count >= min_new[None, :])
+            hit_stop = stop & (out_count >= min_new[None, :]) & valid
             # freeing at total_len + 1 >= max_seq_len keeps the NEXT decode
             # write in-bounds (same rule the token loop applied)
             total_len = self.lengths[A][None, :] + steps
-            hit_len = (out_count >= max_new[None, :]) | (
+            hit_len = ((out_count >= max_new[None, :]) | (
                 total_len + 1 >= self.max_seq_len
-            )
+            )) & valid
             done = hit_stop | hit_len
             any_done = done.any(axis=0)
-            last = np.where(any_done, done.argmax(axis=0), n - 1)  # inclusive
+            last = np.where(any_done, done.argmax(axis=0), av - 1)  # inclusive
 
             for j, (s, req) in enumerate(pairs):
                 k = int(last[j]) + 1

@@ -111,6 +111,19 @@ class GenServer:
                 reg.gauge(
                     "tier_occupancy", "Occupied slots per decode tier"
                 ).set(occ, tier=str(t))
+            # speculative decode (ISSUE 12): lifetime acceptance rate
+            # (unlabeled) + the windowed per-tier rates steering each
+            # tier's draft-length rung
+            drafted = float(eng.stats.get("spec_drafted", 0))
+            accepted = float(eng.stats.get("spec_accepted", 0))
+            rate_g = reg.gauge(
+                "spec_acceptance_rate",
+                "Draft tokens accepted / drafted (per-tier series are "
+                "the controller's acceptance window)",
+            )
+            rate_g.set(accepted / drafted if drafted else 0.0)
+            for t, r in enumerate(eng.spec_acceptance_rates()):
+                rate_g.set(r, tier=str(t))
 
         reg.add_collector(_collect)
 
@@ -474,6 +487,17 @@ class GenServer:
                 "tier_slots": list(self.engine.tier_size),
                 "tier_lens": list(self.engine.tier_bounds),
                 "tier_migrations": stats.get("tier_migrations", 0),
+                # speculative decode (ISSUE 12): draft/accept counters and
+                # the lifetime acceptance rate; per-tier windowed rates
+                # live on the Prometheus surface (spec_acceptance_rate)
+                "spec_drafted": stats.get("spec_drafted", 0),
+                "spec_accepted": stats.get("spec_accepted", 0),
+                "spec_acceptance_rate": round(
+                    stats.get("spec_accepted", 0)
+                    / max(1, stats.get("spec_drafted", 0)),
+                    4,
+                ),
+                "verify_calls": stats.get("verify_calls", 0),
             }
         )
 
@@ -548,6 +572,17 @@ def main():
     p.add_argument("--decode-tier-slots", default="",
                    help="explicit per-tier slot counts (comma list, must "
                         "sum to --n-slots)")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="self-speculative decoding: prompt-lookup drafts "
+                        "verified in one dispatch per tier; output streams "
+                        "stay bit-identical to plain decode")
+    p.add_argument("--spec-ladder", default="",
+                   help="static draft-length ladder (comma list incl. 0, "
+                        "e.g. '0,3,7'); each nonzero rung is its own "
+                        "verify program per (tier, K) bucket")
+    p.add_argument("--spec-draft-len", type=int, default=0,
+                   help="pin the draft length instead of adapting along "
+                        "the ladder (benches/tests)")
     p.add_argument("--telemetry", action="store_true",
                    help="enable trajectory-lifecycle event emission "
                         "(utils/telemetry.py; also via AREAL_TELEMETRY=1)")
@@ -565,6 +600,12 @@ def main():
             [int(x) for x in args.decode_tier_slots.split(",")]
             if args.decode_tier_slots else None
         ),
+        spec_decode=args.spec_decode,
+        spec_ladder=(
+            [int(x) for x in args.spec_ladder.split(",")]
+            if args.spec_ladder else None
+        ),
+        spec_draft_len=args.spec_draft_len or None,
     )
     if args.model_path:
         cfg = TransformerConfig.from_hf(args.model_path)

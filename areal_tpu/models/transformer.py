@@ -800,6 +800,119 @@ def forward_decode(
     return logits, {"k": new_k, "v": new_v}
 
 
+def forward_verify(
+    params: Params,
+    cfg: TransformerConfig,
+    tokens: jax.Array,  # [B, T] committed last token + T-1 draft tokens
+    lengths: jax.Array,  # [B] current sequence length (cache fill) per slot
+    cache: Dict[str, jax.Array],
+    rope_positions: Optional[jax.Array] = None,  # [B] logical rope position
+    key_window: Optional[int] = None,  # STATIC bucketed attended span
+    slot_base: int = 0,  # STATIC first cache row of the dispatched block
+    active: Optional[jax.Array] = None,  # bool [B]; False drops ALL KV writes
+    n_write: Optional[jax.Array] = None,  # int32 [B] valid input positions
+):
+    """Speculative-decode verification: score T input positions per slot of
+    a contiguous tier block in ONE dispatch — the decode analogue of
+    `forward_prefill_cached` (ISSUE 12).  Row b's inputs are its committed
+    pending token followed by T-1 prompt-lookup draft tokens; their K/V
+    land at cache positions lengths[b] .. lengths[b]+T-1 and the returned
+    logits [B, T, V] give, at each position j, the model's distribution for
+    the token at sequence position lengths[b]+j+1 — exactly what T
+    sequential `forward_decode` steps would have computed had every draft
+    been the sampled token.  The caller samples each position under the
+    counter-keyed PRNG and accepts the leading run of agreeing drafts.
+
+    Write-side hazard (same class as decode's idle-slot clamp): position j
+    of row b scatter-drops its K/V write (index M, mode="drop") unless the
+    row is `active` AND j < n_write[b] — padding positions of a short draft
+    and idle slots riding the tier dispatch must never write, because a
+    clamped write at K-1 can land inside a freed slot's retained prefix
+    when K is windowed.  Writes for positions the caller later REJECTS do
+    land here (acceptance needs these very logits) but sit strictly above
+    the accepted frontier; the engine zeroes them post-acceptance
+    (`_verify_chunk`) so no rejected draft's K/V outlives its dispatch.
+
+    The caller guarantees K >= max(lengths of active slots) + T so no
+    active in-budget slot ever clamps."""
+    B, T = tokens.shape
+    M = cache["k"].shape[2]
+    K = min(key_window, M) if key_window else M
+    dtype = jnp.dtype(cfg.dtype)
+    rp = lengths if rope_positions is None else rope_positions
+    offs = jnp.arange(T, dtype=jnp.int32)
+    rope_pos = rp[:, None].astype(jnp.int32) + offs[None, :]  # [B, T]
+    positions = lengths[:, None].astype(jnp.int32) + offs[None, :]  # cache idx
+    cos, sin = rope_cos_sin(rope_pos, cfg.head_dim_, cfg.rope_theta)
+    x = _embed(params, cfg, tokens, dtype, positions=rope_pos)
+    key_pos = jnp.arange(K, dtype=jnp.int32)
+    per_layer_window = (
+        cfg.sliding_window is not None and cfg.layer_is_sliding is not None
+    )
+    # q at cache position g attends cache positions <= g (inclusive: its
+    # own K/V was just written) — same mask family as forward_prefill_cached
+    attn_mask = (key_pos[None, None, :] <= positions[:, :, None])[:, None]
+    mask_win = None
+    if cfg.sliding_window is not None:
+        # window over CACHE indices, not rope positions (VLM divergence)
+        win = attn_mask & (
+            key_pos[None, None, :] > positions[:, :, None] - cfg.sliding_window
+        )[:, None]
+        if per_layer_window:
+            mask_win = win
+        else:
+            attn_mask = win
+    slots = slot_base + jnp.arange(B)
+    widx = jnp.minimum(positions, K - 1)
+    keep = offs[None, :] < (
+        jnp.full((B,), T, jnp.int32) if n_write is None else n_write
+    )[:, None]
+    if active is not None:
+        keep = keep & active[:, None]
+    widx = jnp.where(keep, widx, M)  # out-of-bounds -> scatter drop
+
+    def layer(x, xs):
+        lp, sliding, ck, cv = xs
+        m = attn_mask if mask_win is None else jnp.where(
+            sliding, mask_win, attn_mask
+        )
+        h = _norm(cfg, x, lp, "input_norm")
+        q, k, v = _qkv(cfg, lp, h, dtype)
+        if cfg.pos_emb == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        ck = ck.at[slots[:, None], widx].set(k.astype(ck.dtype), mode="drop")
+        cv = cv.at[slots[:, None], widx].set(v.astype(cv.dtype), mode="drop")
+        ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
+        cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        attn = attention(
+            q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
+            cfg.attn_logit_softcap,
+        )
+        delta = _proj(
+            cfg, lp["attn"], "wo", attn.reshape(B, T, cfg.q_size), dtype,
+            bias="bo",
+        )
+        if cfg.sandwich_norms:
+            delta = _norm(cfg, delta, lp, "sandwich_attn_norm")
+        x = x + delta
+        h = _norm(cfg, x, lp, "post_attn_norm")
+        ffn_out = _ffn(cfg, lp, h, dtype)[0]
+        if cfg.sandwich_norms:
+            ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
+        x = x + ffn_out
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer,
+        x,
+        (params["layers"], _layer_sliding_flags(cfg), cache["k"], cache["v"]),
+    )
+    x = _norm(cfg, x, params, "final_norm")
+    logits = _head_logits(params, cfg, x, dtype)  # [B, T, V]
+    return logits, {"k": new_k, "v": new_v}
+
+
 # ---------------------------------------------------------------------------
 # Init & partitioning
 # ---------------------------------------------------------------------------

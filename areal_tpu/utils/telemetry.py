@@ -286,11 +286,18 @@ class Registry:
                     key = json.dumps(lab, sort_keys=True) if lab else ""
                     out.setdefault(name, {})[key or "_"] = value
             else:
-                for _, labels, value in m.samples():
-                    if labels:
-                        key = json.dumps(labels, sort_keys=True)
-                        out.setdefault(m.name, {})[key] = value
-                    else:
+                samples = list(m.samples())
+                if any(labels for _, labels, _ in samples):
+                    # a family with any labeled series renders as a dict;
+                    # its unlabeled series (legal in Prometheus — e.g. a
+                    # fleet-wide rate next to per-tier rates) keys as ""
+                    d = out.setdefault(m.name, {})
+                    for _, labels, value in samples:
+                        key = (json.dumps(labels, sort_keys=True)
+                               if labels else "")
+                        d[key] = value
+                else:
+                    for _, _, value in samples:
                         out[m.name] = value
         return out
 

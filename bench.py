@@ -393,6 +393,11 @@ def _serving_probe():
         mt["reuse"]["reused_tokens"]
         / max(1, mt["cold"]["prefill_tokens"]), 3,
     )
+    # speculative decode (ISSUE 12): acceptance rate + on/off speedup on
+    # the repetition-heavy workload, tracked alongside the decode curve
+    spec = bs.bench_spec_decode_ab(cfg, params, n_slots=8, gen_tokens=128)
+    out["serving_spec_acceptance_rate"] = spec["on"]["spec_acceptance_rate"]
+    out["serving_spec_decode_speedup"] = spec["spec_over_plain_tok_s"]
     return out
 
 

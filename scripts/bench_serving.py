@@ -77,7 +77,7 @@ def _engine(cfg, params, n_slots, max_seq_len, kv_reuse=True, decode_chunk=8,
 
 
 def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
-                 prompt_len=64):
+                 prompt_len=64, spec_decode=False, draft_len=0):
     """Steady-state decode tokens/sec with every slot busy."""
     from areal_tpu.gen.engine import GenRequest
 
@@ -85,7 +85,9 @@ def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
     out = {}
     for n_slots in slot_counts:
         try:
-            eng = _engine(cfg, params, n_slots, max_seq_len, kv_reuse=False)
+            eng = _engine(cfg, params, n_slots, max_seq_len, kv_reuse=False,
+                          spec_decode=spec_decode,
+                          spec_draft_len=draft_len or None)
             # warmup: compile prefill + decode
             reqs = [
                 GenRequest(rid=f"w{i}",
@@ -118,6 +120,15 @@ def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
                 # decode reads this fraction of the configured cache width
                 "decode_attended_fraction": round(
                     eng.decode_attended_fraction(), 4
+                ),
+                # speculative-decode accounting (ISSUE 12): all zero when
+                # --spec-decode is off
+                "verify_calls": eng.stats["verify_calls"],
+                "spec_draft_tokens": eng.stats["spec_drafted"],
+                "spec_accepted_tokens": eng.stats["spec_accepted"],
+                "spec_acceptance_rate": round(
+                    eng.stats["spec_accepted"]
+                    / max(1, eng.stats["spec_drafted"]), 4
                 ),
             }
             print(f"decode n_slots={n_slots}: {out[str(n_slots)]}",
@@ -365,6 +376,112 @@ def bench_decode_ceiling_ab(cfg, params, n_slots=16, ceilings=(4096, 16384),
     return out
 
 
+def _repetition_params(cfg, params):
+    """Repetition-heavy synthetic regime (ISSUE 12): zeroing the attention
+    output projection makes greedy next-token a pure function of the
+    current token, so every stream settles into a short cycle — the
+    deterministic stand-in for math-style restatement / code-identifier
+    loops that the prompt-lookup drafter feeds on.  Engine-side cost per
+    dispatch is unchanged (serving throughput does not depend on weight
+    values), so the spec-on/off A/B stays fair while guaranteeing
+    draftable streams."""
+    import jax.numpy as jnp
+
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    out["layers"]["attn"] = dict(params["layers"]["attn"])
+    out["layers"]["attn"]["wo"] = jnp.zeros_like(
+        params["layers"]["attn"]["wo"]
+    )
+    return out
+
+
+def bench_spec_decode_ab(cfg, params, n_slots=8, prompt_len=64,
+                         gen_tokens=128, max_seq_len=512, draft_len=31):
+    """ISSUE 12 acceptance A/B: the SAME repetition-heavy greedy workload
+    with speculative decoding off vs on.  Spec-off pays one sequential
+    model call per token; spec-on verifies D+1 positions in one batched
+    dispatch, so accepted drafts collapse dispatches.  Reports per-arm
+    tokens/s, draft/accept counters, the bit-identical-stream check (the
+    correctness contract rides along with the perf number), and the
+    on/off throughput ratio — acceptance bar: >= 1.4x on the CPU rig,
+    target >= 2x on real chips (ROADMAP 3b).
+
+    Prompts are the model's OWN prior greedy output (an untimed setup
+    rollout from one seed token per slot) — the continuation-of-own-output
+    shape that self-speculation targets.  Random tiled prompts would hide
+    the win behind each stream's cycle-entry transient: until a cycle has
+    repeated once inside visible history the drafter has nothing to look
+    up, and in a mixed batch the already-drafting slots drag the still-
+    transient ones through verify dispatches at one token each."""
+    from areal_tpu.gen.engine import GenRequest
+
+    rep_params = _repetition_params(cfg, params)
+    out = {"n_slots": n_slots, "prompt_len": prompt_len,
+           "gen_tokens": gen_tokens, "draft_len": draft_len}
+    rng = np.random.default_rng(9)
+    seeds = rng.integers(0, cfg.vocab_size, n_slots).tolist()
+    seed_eng = _engine(cfg, rep_params, n_slots, max_seq_len, kv_reuse=False)
+    seed_reqs = [
+        GenRequest(rid=f"s{i}", input_ids=[int(s)],
+                   max_new_tokens=prompt_len - 1, temperature=0.0)
+        for i, s in enumerate(seeds)
+    ]
+    seed_eng.generate_blocking(seed_reqs)
+    prompts = [[int(s)] + list(r.output_tokens)
+               for s, r in zip(seeds, seed_reqs)]
+    del seed_eng
+    streams = {}
+    for mode in ("off", "on"):
+        kw = (dict(spec_decode=True, spec_draft_len=draft_len or None)
+              if mode == "on" else {})
+        eng = _engine(cfg, rep_params, n_slots, max_seq_len, kv_reuse=False,
+                      **kw)
+        # full-length warmup: the timed loop crosses the same key-window
+        # buckets, so every decode/verify program compiles here
+        warm = [
+            GenRequest(rid=f"w{i}", input_ids=list(p),
+                       max_new_tokens=gen_tokens, temperature=0.0)
+            for i, p in enumerate(prompts)
+        ]
+        eng.generate_blocking(warm)
+        _reset_stats(eng)
+        eng.retained_len[:] = 0
+        reqs = [
+            GenRequest(rid=f"m{i}", input_ids=list(p),
+                       max_new_tokens=gen_tokens, temperature=0.0)
+            for i, p in enumerate(prompts)
+        ]
+        for r in reqs:
+            eng.submit(r)
+        eng.step()  # admission (prefill) outside the decode timing
+        t0 = time.perf_counter()
+        delivered = 0
+        while any(not r.stop_reason for r in reqs):
+            delivered += eng.step()
+        dt = time.perf_counter() - t0
+        streams[mode] = [tuple(r.output_tokens) for r in reqs]
+        drafted = eng.stats["spec_drafted"]
+        accepted = eng.stats["spec_accepted"]
+        out[mode] = {
+            "tokens_per_sec": round(delivered / dt, 1),
+            "wall_s": round(dt, 2),
+            "decode_calls": eng.stats["decode_calls"],
+            "verify_calls": eng.stats["verify_calls"],
+            "spec_draft_tokens": drafted,
+            "spec_accepted_tokens": accepted,
+            "spec_acceptance_rate": round(accepted / max(1, drafted), 4),
+        }
+        print(f"spec_ab {mode}: {out[mode]}", file=sys.stderr, flush=True)
+        del eng
+    out["streams_bit_identical"] = streams["on"] == streams["off"]
+    out["spec_over_plain_tok_s"] = round(
+        out["on"]["tokens_per_sec"] / max(out["off"]["tokens_per_sec"], 1e-9),
+        3,
+    )
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--slots", default="8,32,64,128,256")
@@ -383,6 +500,19 @@ def main():
     p.add_argument("--no-decode-window", action="store_true",
                    help="A/B with the window disabled (reproduces the "
                         "pre-ISSUE-5 ceiling-bound decode)")
+    # speculative decode knobs (ISSUE 12)
+    p.add_argument("--spec-decode", action="store_true",
+                   help="run the decode curve with self-speculative "
+                        "decoding (prompt-lookup drafts) enabled")
+    p.add_argument("--draft-len", type=int, default=31,
+                   help="pin the draft length D (0 = adaptive ladder); the "
+                        "A/B wants D comfortably above the decode chunk so "
+                        "one verify dispatch commits more than one chunk")
+    p.add_argument("--ab-spec", action="store_true",
+                   help="spec-on/off A/B on the repetition-heavy workload "
+                        "(ISSUE 12 acceptance: >= 1.4x decode tok/s on CPU)")
+    p.add_argument("--spec-slots", type=int, default=8)
+    p.add_argument("--spec-gen", type=int, default=128)
     # group fan-out regime knobs (GRPO-shaped grouped admission)
     p.add_argument("--group-size", type=int, default=8)
     p.add_argument("--group-prompt", type=int, default=256)
@@ -420,7 +550,8 @@ def main():
     result = {"model": args.model, "device_kind": jax.devices()[0].device_kind}
     if not args.skip_decode:
         result["decode"] = bench_decode(
-            cfg, params, [int(s) for s in args.slots.split(",")]
+            cfg, params, [int(s) for s in args.slots.split(",")],
+            spec_decode=args.spec_decode, draft_len=args.draft_len,
         )
     if not args.skip_prefill:
         result["prefill"] = bench_prefill(cfg, params)
@@ -433,6 +564,11 @@ def main():
         result["grouped"] = bench_group_fanout(
             cfg, params, group_size=args.group_size,
             n_groups=args.n_groups, prompt_len=args.group_prompt,
+        )
+    if args.ab_spec:
+        result["spec_ab"] = bench_spec_decode_ab(
+            cfg, params, n_slots=args.spec_slots,
+            gen_tokens=args.spec_gen, draft_len=args.draft_len,
         )
     if not args.skip_ceiling_ab:
         result["decode_ceiling_ab"] = bench_decode_ceiling_ab(

@@ -50,6 +50,15 @@ REFERENCE_CONFIGS = {
         "prompt_bucket": 16,
         "decode_tiers": 1,
     },
+    # ISSUE 12: spec decode on — verify programs ride (tier, K, D) with D
+    # from the nonzero rungs of the default {0, 3, 7} ladder
+    "spec_decode_soak": {
+        "n_slots": 4,
+        "max_seq_len": 256,
+        "prompt_bucket": 16,
+        "decode_tiers": 2,
+        "spec_rungs": 2,
+    },
 }
 
 

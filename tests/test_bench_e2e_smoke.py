@@ -67,6 +67,29 @@ def test_remote_live_publish_smoke():
     assert out["shared_prefill"]["shared_tokens"] > 0
 
 
+@pytest.mark.slow
+def test_remote_interrupt_publish_smoke():
+    """ISSUE 12 satellite: the remote/interrupt combination had never run
+    in the suite (remote+live and remote+abort are covered below) — the
+    `stale_from`-marked e2e BENCH fields kept being carried forward on
+    that gap.  `interrupt` publishes over the HTTP fleet slice abort
+    in-flight requests and clients resume with their accumulated tokens,
+    so the bench must complete and report sane throughput/fan-out
+    accounting under that storm."""
+    out = _run_bench(["--publish-mode", "interrupt",
+                      "--prompt-len", "32"])
+    assert out["transport"] == "remote"
+    assert out["publish_mode"] == "interrupt"
+    a = out["async"]
+    assert a["steps"] == 2 and a["trajectories"] > 0
+    assert a["trajs_per_sec_per_chip"] > 0
+    # group fan-out accounting rode along (group_size 2), and the
+    # interrupt/resume churn keeps the token split self-consistent
+    sp = out["shared_prefill"]
+    assert sp["shared_tokens"] > 0
+    assert sp["suffix_tokens"] >= 0 and sp["prefill_tokens"] > 0
+
+
 @pytest.fixture(scope="module")
 def abort_run(tmp_path_factory):
     """One abort-mode bench run shared by the smoke + lifecycle tests

@@ -486,4 +486,10 @@ def test_generate_batch_groups_share_prefix(live_server):
     assert isinstance(m["tier_occupancy"], list)
     assert m["tier_slots"] and sum(m["tier_slots"]) == engine.n_slots
     assert m["tier_lens"][-1] == engine.max_seq_len
+    # spec-decode accounting (ISSUE 12) is always exported; this engine
+    # runs with spec decode off so every field sits at zero
+    assert m["spec_drafted"] == 0
+    assert m["spec_accepted"] == 0
+    assert m["spec_acceptance_rate"] == 0.0
+    assert m["verify_calls"] == 0
     assert m["tier_migrations"] >= 0

@@ -323,6 +323,62 @@ def test_gen_server_json_metrics_survive_missing_stats_key(gen_server):
         engine.stats["reservations_lapsed"] = removed
 
 
+def test_gen_server_spec_decode_telemetry(enabled):
+    """Spec decode (ISSUE 12): draft/accept counters, the per-tier
+    acceptance-rate gauge, and spec_verify lifecycle spans all ride the
+    gen surface when speculative decoding is live."""
+    import jax
+
+    from areal_tpu.gen.engine import GenEngine
+    from areal_tpu.models import init_params
+    from areal_tpu.models.model_config import tiny_config
+
+    from tests.test_gen_server_integration import _boot_server
+
+    cfg = tiny_config(vocab_size=89, qkv_bias=True,
+                      hf_architecture="Qwen2ForCausalLM", eos_token_id=None)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = GenEngine(cfg, params=params, n_slots=4, max_seq_len=96,
+                       prompt_bucket=16, spec_decode=True, spec_draft_len=3)
+    _, addr, stop = _boot_server(engine)
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}/generate",
+            data=json.dumps({
+                "rid": "spec-tel-0",
+                "input_ids": [5, 6, 7] * 4,  # periodic: prompt lookup hits
+                "sampling_params": {"max_new_tokens": 12,
+                                    "temperature": 0.0},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert len(out["output_tokens"]) == 12
+        assert engine.stats["spec_drafted"] > 0
+        assert engine.stats["verify_calls"] > 0
+        parsed = parse_prometheus_text(_scrape(addr))
+        assert parsed["areal_gen_spec_drafted_total"][""] > 0
+        assert parsed["areal_gen_verify_calls_total"][""] > 0
+        rate = parsed["areal_gen_spec_acceptance_rate"]
+        assert "" in rate  # lifetime rate
+        assert any(lab.startswith('{tier=') for lab in rate)
+        # the legacy JSON dict carries the same accounting
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=10) as r:
+            legacy = json.loads(r.read())
+        assert legacy["spec_drafted"] == engine.stats["spec_drafted"]
+        assert 0.0 <= legacy["spec_acceptance_rate"] <= 1.0
+        # every verify dispatch leaves a spec_verify lifecycle span
+        evs = [e for e in telemetry.EVENTS.snapshot()
+               if e["event"] == "spec_verify"]
+        assert evs, "no spec_verify lifecycle events recorded"
+        assert evs[0]["drafted"] >= 1
+        assert "latency_s" in evs[0] and "tier" in evs[0]
+    finally:
+        stop()
+
+
 @pytest.fixture()
 def router_addr():
     from areal_tpu.gen.router import Router, RouterConfig
