@@ -453,6 +453,7 @@ class RemoteInfEngine(InferenceEngine):
                 stop_reason=stop_reason or "length",
                 output_len=len(out_tokens), attempts=attempt,
                 latency_s=time.perf_counter() - start,
+                ttft_s=ttft if ttft != float("inf") else None,
             )
         return ModelResponse(
             input_tokens=req.input_ids[:input_len],

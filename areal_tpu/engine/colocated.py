@@ -186,6 +186,7 @@ class ColocatedEngine:
         versions: List[int] = []
         input_ids = list(req.input_ids)
         t0 = time.perf_counter()
+        first_token_ts: Optional[float] = None
         while True:
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
@@ -216,6 +217,8 @@ class ColocatedEngine:
             )
             self.engine.submit(gr)
             gr = await fut
+            if first_token_ts is None and gr.first_token_ts > 0.0:
+                first_token_ts = gr.first_token_ts
             accumulated.extend(gr.output_tokens)
             logprobs.extend(gr.output_logprobs)
             versions.extend(gr.output_versions)
@@ -231,6 +234,8 @@ class ColocatedEngine:
             stop_reason=gr.stop_reason,
             tokenizer=req.tokenizer,
             latency=time.perf_counter() - t0,
+            ttft=(first_token_ts - t0 if first_token_ts is not None
+                  else float("inf")),
         )
 
     def rollout_batch(

@@ -188,9 +188,13 @@ class GenRequest:
     group_id: str = ""
     group_n: int = 0
     # telemetry (utils/telemetry.py): trajectory trace id carried from the
-    # wire + the submit() timestamp backing the admission-wait histogram
+    # wire + the submit() timestamp backing the admission-wait histogram;
+    # first_token_ts/finish_ts complete the per-request latency triple
+    # (TTFT / end-to-end / inter-token) on the same perf_counter clock
     trace_id: str = ""
     submit_ts: float = 0.0
+    first_token_ts: float = 0.0
+    finish_ts: float = 0.0
     # filled by the engine
     output_tokens: List[int] = field(default_factory=list)
     output_logprobs: List[float] = field(default_factory=list)
@@ -200,6 +204,7 @@ class GenRequest:
 
     def finish(self, reason: str):
         self.stop_reason = reason
+        self.finish_ts = time.perf_counter()
         if self.on_done is not None:
             self.on_done(self)
 
@@ -1882,6 +1887,8 @@ class GenEngine:
         req.output_tokens.append(tok)
         req.output_logprobs.append(logp)
         req.output_versions.append(self.version)
+        if req.first_token_ts == 0.0:
+            req.first_token_ts = time.perf_counter()
         # the sampled token's K/V lands at cache position lengths[s] on the
         # next decode step; mirror it for prefix matching
         self.seq_tokens[s, min(int(self.lengths[s]), self.max_seq_len - 1)] = tok
@@ -2336,6 +2343,8 @@ class GenEngine:
             for j, (s, req) in enumerate(pairs):
                 k = int(last[j]) + 1
                 seq = tk[:k, j]
+                if c0[j] == 0 and k > 0 and req.first_token_ts == 0.0:
+                    req.first_token_ts = time.perf_counter()
                 req.output_tokens.extend(seq.tolist())
                 req.output_logprobs.extend(lp[:k, j].tolist())
                 req.output_versions.extend([version] * k)

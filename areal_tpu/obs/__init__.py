@@ -1,0 +1,21 @@
+"""Trace analytics for the lifecycle telemetry layer (ISSUE 14).
+
+The telemetry PR (ISSUE 10) made the fleet *emit* evidence — lifecycle
+spans riding a ``trace_id`` from client submit through admission,
+prefill, per-tier decode chunks, interrupt/resume, reward, and train
+consumption.  This package *consumes* it, strictly offline: everything
+here parses dumped JSONL (or an in-memory event list) and never touches
+engine internals, so it can never put work on a hot path.
+
+- :mod:`areal_tpu.obs.trace` — per-trajectory records, the trace
+  completeness linter, and per-stage latency decomposition with an
+  accounting identity (stage sum ≈ client-measured end-to-end).
+- :mod:`areal_tpu.obs.slo` — SLO report generator (JSON + markdown):
+  p50/p90/p99 per stage, TTFT, inter-token latency, goodput, staleness
+  and pause-window distributions.  ``python -m areal_tpu.obs.slo``.
+- :mod:`areal_tpu.obs.workload` — arrival-process extraction from a
+  recorded trace plus a seeded synthetic mixed workload (chat bursts,
+  GRPO groups, long-context stragglers) for `scripts/bench_replay.py`.
+"""
+
+from areal_tpu.obs import slo, trace, workload  # noqa: F401

@@ -40,6 +40,11 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 _enabled = os.environ.get("AREAL_TELEMETRY", "") not in ("", "0", "false")
 
+# Cached per process: every event record carries the emitting pid so the
+# trace analyzer knows when two events share a perf_counter epoch (the
+# monotonic clock is only comparable within one process).
+_PID = os.getpid()
+
 
 def set_enabled(on: bool) -> None:
     global _enabled
@@ -391,7 +396,15 @@ class EventLog:
              **fields: Any) -> None:
         if not _enabled:
             return
-        rec: Dict[str, Any] = {"ts": time.time(), "event": event}
+        # Paired clocks: wall `ts` joins events across processes, mono
+        # `mono` (perf_counter) gives skew-free stage durations within
+        # one process.  The analyzer prefers mono when pids match.
+        rec: Dict[str, Any] = {
+            "ts": time.time(),
+            "mono": time.perf_counter(),
+            "pid": _PID,
+            "event": event,
+        }
         if trace_id:
             rec["trace_id"] = trace_id
             rec.setdefault("trace_key", trace_key(trace_id))
@@ -416,6 +429,20 @@ class EventLog:
 
     def dump_jsonl(self, path: str) -> int:
         events = self.snapshot()
+        with self._lock:
+            dropped = self.dropped
+        if dropped:
+            # Ring overflow is silent data loss to downstream analysis;
+            # stamp it into the dump so the trace analyzer can refuse to
+            # call a lossy log "complete" (see areal_tpu/obs/trace.py).
+            events = events + [{
+                "ts": time.time(),
+                "mono": time.perf_counter(),
+                "pid": _PID,
+                "event": "telemetry_meta",
+                "dropped_events": dropped,
+                "capacity": self._events.maxlen,
+            }]
         with open(path, "w") as f:
             for e in events:
                 f.write(json.dumps(e) + "\n")
@@ -469,6 +496,23 @@ EVENTS = EventLog(
 
 def emit(event: str, trace_id: Optional[str] = None, **fields: Any) -> None:
     EVENTS.emit(event, trace_id=trace_id, **fields)
+
+
+def _register_events_dropped(reg: Registry) -> None:
+    c = reg.counter(
+        "areal_telemetry_events_dropped_total",
+        "Lifecycle events lost to EventLog ring overflow; any nonzero "
+        "value marks downstream trace analysis incomplete",
+    )
+    reg.add_collector(lambda: c.set_total(float(EVENTS.dropped)))
+
+
+# All three fleet surfaces (gen server, router, trainer endpoint) render
+# these registries, so ring overflow is visible wherever /metrics is —
+# the name is fully qualified and therefore served verbatim on each.
+for _reg in (GEN, ROUTER, TRAIN):
+    _register_events_dropped(_reg)
+del _reg
 
 
 # ---------------------------------------------------------------------------

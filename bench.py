@@ -388,6 +388,15 @@ def _serving_probe():
         out["serving_decode_attended_fraction"] = decode["64"].get(
             "decode_attended_fraction"
         )
+        # latency distributions (ISSUE 14): BENCH carries p50/p99 curves,
+        # not single-run means
+        lat = decode["64"].get("latency") or {}
+        for stat, key in (("ttft", "ttft_s"), ("e2e", "e2e_s"),
+                          ("itl", "inter_token_s")):
+            d = lat.get(key)
+            if d:
+                out[f"serving_decode_{stat}_p50_s"] = round(d["p50"], 4)
+                out[f"serving_decode_{stat}_p99_s"] = round(d["p99"], 4)
     out["serving_multiturn_kv_reuse_speedup"] = mt["speedup"]
     out["serving_multiturn_prefill_tokens_saved_frac"] = round(
         mt["reuse"]["reused_tokens"]

@@ -34,6 +34,91 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from areal_tpu.obs.trace import dist_summary  # noqa: E402 (stdlib-only)
+
+
+class _LatencyRecorder:
+    """Collects per-request client latencies (ModelResponse.latency /
+    .ttft) across a measured mode so the bench reports p50/p99
+    distributions instead of single-number means (ISSUE 14)."""
+
+    def __init__(self):
+        self.samples = []
+        self._mark = 0
+
+    def reset(self):
+        self.samples = []
+        self._mark = 0
+
+    def mark(self):
+        # Warmup boundary: prefer samples completed after this point.  The
+        # pre-mark ones stay as a fallback — prepare_batch keeps batches in
+        # flight, so a short smoke run can consume only episodes whose
+        # generation finished during warmup, and a destructive reset here
+        # would leave the measured window with zero samples.
+        self._mark = len(self.samples)
+
+    def record(self, resp):
+        self.samples.append((
+            float(resp.latency),
+            float(resp.ttft),
+            int(resp.output_len),
+        ))
+
+    def summary(self):
+        post = self.samples[self._mark:]
+        use = post or self.samples
+        if not use:
+            return None
+        e2e = [s[0] for s in use if s[0] != float("inf")]
+        ttft = [s[1] for s in use if s[1] != float("inf")]
+        itl = [
+            (lat - tf) / (n - 1)
+            for lat, tf, n in use
+            if lat != float("inf") and tf != float("inf") and n > 1
+        ]
+        return {
+            "n": len(use),
+            "includes_warmup": not post,
+            "e2e_s": dist_summary(e2e),
+            "ttft_s": dist_summary(ttft),
+            "inter_token_s": dist_summary(itl),
+        }
+
+
+class _RecordingEngine:
+    """Transparent engine proxy: forwards everything, taps agenerate."""
+
+    def __init__(self, inner, recorder):
+        self._inner = inner
+        self._recorder = recorder
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def agenerate(self, req):
+        resp = await self._inner.agenerate(req)
+        self._recorder.record(resp)
+        return resp
+
+
+class _RecordingWorkflow:
+    """Workflow wrapper interposing the recording engine.  Works for
+    every transport x mode combination because both WorkflowExecutor
+    and rollout_batch drive episodes through
+    ``workflow.arun_episode(engine, data)``."""
+
+    def __init__(self, inner, recorder):
+        self._inner = inner
+        self._recorder = recorder
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def arun_episode(self, engine, data):
+        return await self._inner.arun_episode(
+            _RecordingEngine(engine, self._recorder), data)
+
 
 def _reward_any_even(prompt, completions, prompt_ids, completion_ids, **kw):
     """Module-level so the reward process pool can pickle it."""
@@ -205,7 +290,7 @@ def _make_remote_parts(args, actor, cfg):
 
 
 def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
-                  warmup: int, label: str = ""):
+                  warmup: int, label: str = "", recorder=None):
     """The shared timed region of every transport x mode combination:
     rollout -> train -> version bump -> publish, with warmup reset and the
     same stats dict — so the colocated/remote A/B can never silently
@@ -214,6 +299,8 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
     pauses = []
     rewards = []
     t_start = None
+    if recorder is not None:
+        recorder.reset()
     for step in range(warmup + steps):
         if step == warmup:
             import jax
@@ -222,6 +309,8 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
             trajs = tokens = 0
             pauses = []
             rewards = []
+            if recorder is not None:
+                recorder.mark()  # warmup requests must not skew p99s
             t_start = time.perf_counter()
         batch = get_batch()
         trajs += int(np.asarray(batch["attention_mask"]).shape[0])
@@ -236,7 +325,9 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
     actor.flush_stats()
     jax.block_until_ready(actor.params)
     wall = time.perf_counter() - t_start
+    latency = recorder.summary() if recorder is not None else None
     return {
+        "latency": latency,
         "steps": steps,
         "trajectories": trajs,
         "effective_tokens": tokens,
@@ -251,7 +342,8 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
 
 
 def run_mode_remote(mode: str, actor, client, server_engine, meta, workflow,
-                    dataset, batch_size: int, steps: int, warmup: int = 1):
+                    dataset, batch_size: int, steps: int, warmup: int = 1,
+                    recorder=None):
     """Fleet-path counterpart of run_mode: rollouts over HTTP via the
     client's executor, publishes via the trainer's stage+commit transfer
     choreography (live or abort per meta.live_commit)."""
@@ -281,7 +373,7 @@ def run_mode_remote(mode: str, actor, client, server_engine, meta, workflow,
         return float(server_engine.last_pause_s)
 
     return _measure_loop(mode, actor, get_batch, publish, steps, warmup,
-                         label="remote ")
+                         label="remote ", recorder=recorder)
 
 
 def _train_consume(actor, batch):
@@ -341,7 +433,8 @@ def plan_warm_shapes(args, dataset, actor):
 
 
 def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
-             steps: int, warmup: int = 1, interrupt_publish: bool = False):
+             steps: int, warmup: int = 1, interrupt_publish: bool = False,
+             recorder=None):
     """-> {trajs_per_sec, effective_tokens_per_sec, steps, pause_s_mean}"""
     from areal_tpu.api.config import InferenceEngineConfig
     from areal_tpu.core.executor import WorkflowExecutor
@@ -385,7 +478,8 @@ def run_mode(mode: str, actor, serving, workflow, dataset, batch_size: int,
         )
 
     try:
-        return _measure_loop(mode, actor, get_batch, publish, steps, warmup)
+        return _measure_loop(mode, actor, get_batch, publish, steps, warmup,
+                             recorder=recorder)
     finally:
         if executor is not None:
             executor.destroy()
@@ -594,6 +688,11 @@ def main():
                 temperature=1.0,
             ),
         )
+    # per-request latency distributions (TTFT / inter-token / e2e) come
+    # from a transparent workflow wrapper; transport-agnostic because
+    # every episode path funnels through workflow.arun_episode
+    recorder = _LatencyRecorder()
+    workflow = _RecordingWorkflow(workflow, recorder)
     rng = np.random.default_rng(0)
     dataset = []
     if args.dataset == "gsm8k-synth":
@@ -664,13 +763,14 @@ def main():
                     result[mode] = run_mode_remote(
                         mode, actor, client, server_engine, meta, workflow,
                         dataset, args.batch_size, args.steps,
-                        warmup=args.warmup,
+                        warmup=args.warmup, recorder=recorder,
                     )
                 else:
                     result[mode] = run_mode(
                         mode, actor, serving, workflow, dataset,
                         args.batch_size, args.steps, warmup=args.warmup,
                         interrupt_publish=interrupt_publish,
+                        recorder=recorder,
                     )
         if "sync" in result and "async" in result:
             result["async_over_sync_trajs_per_sec"] = round(

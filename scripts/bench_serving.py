@@ -35,6 +35,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from areal_tpu.obs.trace import dist_summary  # noqa: E402
+
 
 def serving_model_setup(model: str = "qwen25_1p5b"):
     """The canonical serving-bench model: Qwen2.5-1.5B shapes, bf16,
@@ -112,9 +114,29 @@ def bench_decode(cfg, params, slot_counts, max_seq_len=512, gen_tokens=128,
             while any(not r.stop_reason for r in reqs):
                 delivered += eng.step()
             dt = time.perf_counter() - t0
+            # per-request latency triple off the GenRequest perf_counter
+            # stamps (submit -> first delivered token -> finish); the
+            # admission step above sits inside TTFT, as it does for a
+            # real client
+            ttfts = [r.first_token_ts - r.submit_ts for r in reqs
+                     if r.first_token_ts > 0.0]
+            e2es = [r.finish_ts - r.submit_ts for r in reqs
+                    if r.finish_ts > 0.0]
+            itls = [
+                (r.finish_ts - r.first_token_ts)
+                / max(1, len(r.output_tokens) - 1)
+                for r in reqs
+                if r.finish_ts > 0.0 and r.first_token_ts > 0.0
+                and len(r.output_tokens) > 1
+            ]
             out[str(n_slots)] = {
                 "tokens_per_sec": round(delivered / dt, 1),
                 "wall_s": round(dt, 2),
+                "latency": {
+                    "ttft_s": dist_summary(ttfts),
+                    "e2e_s": dist_summary(e2es),
+                    "inter_token_s": dist_summary(itls),
+                },
                 "decode_calls": eng.stats["decode_calls"],
                 # attended span / ceiling (ISSUE 5 window accounting):
                 # decode reads this fraction of the configured cache width

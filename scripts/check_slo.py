@@ -1,0 +1,225 @@
+"""SLO regression gate: diff a report against a checked-in baseline.
+
+Every perf/fleet PR gets judged by the SLO reports the trace-analytics
+layer produces (`python -m areal_tpu.obs.slo`, `scripts/bench_replay.py
+--slo-report`).  This gate compares one report against
+`tests/data/slo_baseline.json` with per-metric tolerance bands:
+
+- **completeness is non-negotiable**: a report whose trace log dropped
+  events, has orphan spans, or violates the accounting identity hard-
+  fails regardless of tolerances — numbers from a lossy log are not
+  evidence;
+- **soft band**: each baseline metric carries a relative tolerance
+  (rig noise on shared CI runners is real; bands are wide on purpose);
+- **hard band**: ``hard_fail_ratio`` (default 2.0) — a >2x regression
+  fails even in ``--hard-only`` mode, which is what CI runs so a noisy
+  runner can't block a PR but a real regression still does.
+
+Baseline format (per metric, dotted path into the report JSON):
+
+  {"schema": "areal-slo-baseline/v1",
+   "hard_fail_ratio": 2.0,
+   "metrics": {
+     "e2e_s.p99":   {"baseline": 1.9, "tolerance": 0.75,
+                     "direction": "upper"},
+     "goodput.output_tokens_per_s": {"baseline": 140.0,
+                     "tolerance": 0.5, "direction": "lower"}}}
+
+``direction: upper`` fails when the report exceeds
+``baseline * (1 + tolerance)`` (latency-like); ``lower`` fails when it
+drops below ``baseline * (1 - tolerance)`` (throughput-like).
+
+``--write-baseline`` regenerates the baseline from a known-good report
+(keeping the metric list and bands), so updating it after an accepted
+perf change is one command, not hand-editing JSON.
+
+Exit codes: 0 = within bands; 1 = any violation (soft violations are
+ignored under ``--hard-only``); 2 = unusable input.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+SCHEMA = "areal-slo-baseline/v1"
+
+
+def lookup(report: Dict[str, Any], dotted: str) -> Optional[float]:
+    cur: Any = report
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+def check_metric(name: str, spec: Dict[str, Any], value: Optional[float],
+                 hard_ratio: float) -> Tuple[str, str]:
+    """-> (verdict, detail); verdict in {ok, soft, hard, missing}."""
+    base = float(spec["baseline"])
+    tol = float(spec.get("tolerance", 0.5))
+    direction = spec.get("direction", "upper")
+    if value is None:
+        return "missing", f"{name}: not present in report"
+    # hard must never be easier to trip than soft (a wide soft band with
+    # a small hard ratio would otherwise invert the two)
+    if direction == "upper":
+        soft_limit = base * (1.0 + tol)
+        hard_limit = max(base * hard_ratio, soft_limit)
+        if value > hard_limit:
+            return "hard", (f"{name}: {value:.4g} > {hard_limit:.4g} "
+                            f"(baseline {base:.4g} x{hard_ratio:g})")
+        if value > soft_limit:
+            return "soft", (f"{name}: {value:.4g} > {soft_limit:.4g} "
+                            f"(baseline {base:.4g} +{tol:.0%})")
+    elif direction == "lower":
+        soft_limit = base * (1.0 - tol)
+        hard_limit = min(base / hard_ratio, soft_limit)
+        if value < hard_limit:
+            return "hard", (f"{name}: {value:.4g} < {hard_limit:.4g} "
+                            f"(baseline {base:.4g} /{hard_ratio:g})")
+        if value < soft_limit:
+            return "soft", (f"{name}: {value:.4g} < {soft_limit:.4g} "
+                            f"(baseline {base:.4g} -{tol:.0%})")
+    else:
+        return "missing", f"{name}: unknown direction {direction!r}"
+    return "ok", f"{name}: {value:.4g} (baseline {base:.4g})"
+
+
+def run_gate(report: Dict[str, Any], baseline: Dict[str, Any],
+             hard_only: bool = False) -> Tuple[int, str]:
+    lines = []
+    hard = soft = 0
+
+    # completeness + accounting identity gate first: tolerances cannot
+    # excuse numbers computed from a lossy or inconsistent trace log
+    comp = report.get("completeness", {})
+    acct = report.get("accounting", {})
+    if not comp.get("complete", False):
+        hard += 1
+        lines.append(
+            "HARD completeness: dropped_events="
+            f"{comp.get('dropped_events')} orphans="
+            f"{len(comp.get('orphan_traces', []))} unjoined_resubmits="
+            f"{comp.get('unjoined_resubmits')}")
+    if not acct.get("ok", False):
+        hard += 1
+        lines.append(
+            f"HARD accounting identity: violations={acct.get('violations')} "
+            f"max_rel_err={acct.get('max_rel_err')}")
+
+    hard_ratio = float(baseline.get("hard_fail_ratio", 2.0))
+    for name, spec in sorted(baseline.get("metrics", {}).items()):
+        verdict, detail = check_metric(
+            name, spec, lookup(report, name), hard_ratio)
+        if verdict == "hard":
+            hard += 1
+            lines.append(f"HARD {detail}")
+        elif verdict in ("soft", "missing"):
+            soft += 1
+            lines.append(f"soft {detail}")
+        else:
+            lines.append(f"  ok {detail}")
+
+    failed = hard > 0 or (soft > 0 and not hard_only)
+    verdict = "FAIL" if failed else "PASS"
+    mode = " (hard-only)" if hard_only else ""
+    lines.append(f"{verdict}{mode}: {hard} hard, {soft} soft violations "
+                 f"over {len(baseline.get('metrics', {}))} metrics")
+    return (1 if failed else 0), "\n".join(lines)
+
+
+def write_baseline(report: Dict[str, Any], old: Optional[Dict[str, Any]],
+                   tolerance: float) -> Dict[str, Any]:
+    """New baseline from a known-good report: keep the old metric list
+    and bands when present, refresh only the values; otherwise seed the
+    default metric set."""
+    if old and old.get("metrics"):
+        metrics = {
+            name: {**spec, "baseline": lookup(report, name)}
+            for name, spec in old["metrics"].items()
+            if lookup(report, name) is not None
+        }
+        hard_ratio = float(old.get("hard_fail_ratio", 2.0))
+    else:
+        defaults = [
+            ("e2e_s.p50", "upper"),
+            ("e2e_s.p99", "upper"),
+            ("ttft_s.p99", "upper"),
+            ("stages.admission_wait.p99", "upper"),
+            ("stages.decode.p99", "upper"),
+            ("goodput.output_tokens_per_s", "lower"),
+        ]
+        metrics = {}
+        for name, direction in defaults:
+            v = lookup(report, name)
+            if v is not None:
+                metrics[name] = {"baseline": v, "tolerance": tolerance,
+                                 "direction": direction}
+        hard_ratio = 2.0
+    return {
+        "schema": SCHEMA,
+        "source_report": report.get("run_id", ""),
+        "hard_fail_ratio": hard_ratio,
+        "metrics": metrics,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--report", required=True, help="SLO report JSON")
+    p.add_argument("--baseline", default="tests/data/slo_baseline.json")
+    p.add_argument("--hard-only", action="store_true",
+                   help="CI mode: only completeness violations and "
+                        ">hard_fail_ratio regressions fail")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate --baseline from --report instead of "
+                        "gating (run after an accepted perf change)")
+    p.add_argument("--tolerance", type=float, default=0.75,
+                   help="default soft band when seeding a new baseline")
+    args = p.parse_args()
+
+    try:
+        with open(args.report) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"unusable report {args.report}: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        old = None
+        try:
+            with open(args.baseline) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            pass
+        baseline = write_baseline(report, old, args.tolerance)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2)
+            f.write("\n")
+        print(f"baseline written: {args.baseline} "
+              f"({len(baseline['metrics'])} metrics)")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"unusable baseline {args.baseline}: {e}", file=sys.stderr)
+        return 2
+    if baseline.get("schema") != SCHEMA:
+        print(f"baseline schema mismatch: {baseline.get('schema')!r}",
+              file=sys.stderr)
+        return 2
+
+    rc, text = run_gate(report, baseline, hard_only=args.hard_only)
+    print(text)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -206,3 +206,38 @@ def test_trajectory_lifecycle_reconstructs_from_jsonl(abort_run):
     assert metrics["gen"]["areal_gen_pause_window_seconds_count"]["_"] >= 1
     assert (metrics["train"]
             ["areal_train_staleness_at_consumption_count"]["_"] >= 1)
+
+
+def test_slo_report_reconstructs_recorded_run(abort_run):
+    """ISSUE 14 acceptance: from one recorded e2e run the analyzer must
+    produce an SLO report that is complete (zero dropped events, no
+    orphan spans) and satisfies the accounting identity — the per-stage
+    sums agree with each trajectory's client-measured end-to-end — plus
+    the satellite latency percentiles in the bench JSON itself."""
+    from areal_tpu.obs.slo import build_report, render_markdown
+
+    out, _ = abort_run
+    report = build_report(out["telemetry"]["events_jsonl"], run_id="smoke")
+    comp = report["completeness"]
+    assert comp["complete"], comp
+    assert comp["dropped_events"] == 0
+    acct = report["accounting"]
+    assert acct["ok"], acct
+    assert acct["checked"] > 0
+    assert report["trajectories"]["closed"] > 0
+    assert report["e2e_s"]["count"] > 0
+    # real server spans in the log -> a true decomposition, not opaque
+    assert "decode" in report["stages"]
+    assert "admission_wait" in report["stages"]
+    # abort publishes leave interrupt windows; staleness evidence joined
+    assert report["staleness"] is not None
+    md = render_markdown(report)
+    assert "complete: **True**" in md and "stage:decode" in md
+
+    # satellite: the bench JSON now carries client-side p50/p99 latency
+    lat = out["async"]["latency"]
+    assert lat["n"] > 0
+    assert lat["e2e_s"]["count"] == lat["n"]
+    assert 0 < lat["e2e_s"]["p50"] <= lat["e2e_s"]["p99"]
+    assert lat["ttft_s"] is not None
+    assert lat["ttft_s"]["p50"] <= lat["e2e_s"]["p99"]

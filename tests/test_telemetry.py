@@ -579,3 +579,63 @@ def test_trace_id_rides_the_wire_and_events_join(gen_server, enabled):
     # decode chunks carry the trace id in their per-tier id lists
     chunks = [e for e in evs if e["event"] == "decode_chunk"]
     assert any("wire-1" in e.get("trace_ids", ()) for e in chunks)
+
+
+# ---------------------------------------------------------------------------
+# paired clocks + drop accounting (ISSUE 14 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_events_carry_paired_clocks_and_pid(enabled):
+    """Every event records wall ts (cross-process joins), a perf_counter
+    mono stamp (NTP-immune single-process decomposition), and the
+    emitting pid so the analyzer knows when mono is comparable."""
+    telemetry.emit("rollout_submit", trace_id="clk-1", input_len=4)
+    telemetry.emit("gen_done", trace_id="clk-1", latency_s=0.1)
+    evs = [e for e in telemetry.EVENTS.snapshot()
+           if e.get("trace_id") == "clk-1"]
+    assert len(evs) == 2
+    for e in evs:
+        assert e["pid"] == os.getpid()
+        assert e["mono"] > 0 and e["ts"] > 0
+    assert evs[1]["mono"] >= evs[0]["mono"]
+    assert evs[1]["ts"] >= evs[0]["ts"]
+
+
+def test_dump_jsonl_meta_trailer_records_drops(enabled, tmp_path):
+    """A ring that overflowed must say so in the dump itself — the
+    telemetry_meta trailer is what marks the log lossy for the trace
+    analyzer (a lossless dump carries no trailer)."""
+    log = EventLog(capacity=2)
+    log.emit("e", trace_id="t0")
+    jl = tmp_path / "lossless.jsonl"
+    assert log.dump_jsonl(str(jl)) == 1
+    assert "telemetry_meta" not in jl.read_text()
+
+    for i in range(5):
+        log.emit("e", trace_id=f"t{i}")
+    jl2 = tmp_path / "lossy.jsonl"
+    n = log.dump_jsonl(str(jl2))
+    lines = [json.loads(ln) for ln in jl2.read_text().splitlines()]
+    assert n == len(lines) == 3  # 2 events + the trailer
+    meta = lines[-1]
+    assert meta["event"] == "telemetry_meta"
+    assert meta["dropped_events"] == log.dropped == 4
+    assert meta["capacity"] == 2
+
+
+def test_events_dropped_total_on_all_three_surfaces(enabled):
+    """areal_telemetry_events_dropped_total mirrors EVENTS.dropped on the
+    gen, router, AND train registries (scrape-time collector), so any
+    surface can alarm on lifecycle-evidence loss."""
+    name = "areal_telemetry_events_dropped_total"
+    before = telemetry.EVENTS.dropped
+    try:
+        telemetry.EVENTS.dropped = before + 7
+        for reg in (telemetry.GEN, telemetry.ROUTER, telemetry.TRAIN):
+            snap = reg.snapshot()
+            assert snap[name] == before + 7, reg.namespace
+            parsed = parse_prometheus_text(reg.render_prometheus())
+            assert parsed[name][""] == before + 7
+    finally:
+        telemetry.EVENTS.dropped = before

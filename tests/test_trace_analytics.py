@@ -1,0 +1,348 @@
+"""Trace analytics & SLO harness (ISSUE 14): the lifecycle-JSONL
+analyzer's stage state machine and accounting identity, the completeness
+linter on truncated/orphaned fixture logs (tests/data/traces/), the SLO
+report schema + CLI, the replay workload generators, and the
+`scripts/check_slo.py` regression gate against the checked-in baseline."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from areal_tpu.obs import workload as wl
+from areal_tpu.obs.slo import SCHEMA as SLO_SCHEMA
+from areal_tpu.obs.slo import build_report, render_markdown
+from areal_tpu.obs.slo import main as slo_main
+from areal_tpu.obs.trace import analyze, check_accounting, dist_summary
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+TRACES = os.path.join(DATA, "traces")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECK_SLO = os.path.join(REPO, "scripts", "check_slo.py")
+
+
+def _load_check_slo():
+    spec = importlib.util.spec_from_file_location("check_slo", CHECK_SLO)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _clean_events():
+    with open(os.path.join(TRACES, "clean.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# stage state machine + accounting identity
+# ---------------------------------------------------------------------------
+
+
+def test_stage_partition_clean_trace():
+    rep = analyze(os.path.join(TRACES, "clean.jsonl"))
+    assert rep.completeness.complete
+    (rec,) = rep.records
+    assert rec.closed and not rec.lost
+    # the fixture's spans partition exactly: 0.1 queue + 0.18 prefill +
+    # (0.22 + 0.2) decode + 0.05 delivery tail over a 0.75s event span
+    assert rec.stages == pytest.approx({
+        "admission_wait": 0.1, "prefill": 0.18,
+        "decode": 0.42, "tail": 0.05,
+    })
+    assert rec.span_s == pytest.approx(0.75)
+    assert rec.e2e_s == pytest.approx(0.74)
+    assert rec.identity_rel == pytest.approx(0.01 / 0.74)
+    assert rec.ttft_s == pytest.approx(0.5)
+    # joined across the trajectory: reward after done, consume via key
+    assert rec.reward == 1.0
+    assert rec.reward_latency_s == pytest.approx(0.15)
+    assert rec.staleness == 1.0
+    assert rec.consume_latency_s == pytest.approx(0.75)
+    acct = check_accounting(rep.records)
+    assert acct.ok and acct.checked == 1 and acct.violations == 0
+
+
+def test_accounting_identity_violation_detected():
+    evs = _clean_events()
+    done = next(e for e in evs if e["event"] == "gen_done")
+    done["latency_s"] = 2.0  # client claims 2s; the spans sum to 0.75s
+    rep = analyze(evs)
+    acct = check_accounting(rep.records)
+    assert not acct.ok and acct.violations == 1
+    assert acct.max_rel_err > 0.5
+    report = build_report(evs)
+    assert report["accounting"]["ok"] is False
+    assert report["complete"] is False  # identity failure taints the report
+
+
+def test_sub_floor_jitter_is_not_a_violation():
+    evs = _clean_events()
+    next(e for e in evs if e["event"] == "gen_done")["latency_s"] = 0.73
+    acct = check_accounting(analyze(evs).records)
+    # 0.02s absolute error is under the floor even though 2.7% > nothing
+    assert acct.ok
+
+
+def test_monotonic_clock_used_when_single_pid():
+    # wall clocks identical (an NTP step ate the deltas); mono carries
+    # the real spacing — the partition must come from mono
+    evs = [
+        {"ts": 5.0, "mono": 10.0, "pid": 7, "event": "rollout_submit",
+         "trace_id": "m1", "input_len": 4},
+        {"ts": 5.0, "mono": 10.2, "pid": 7, "event": "admission",
+         "trace_id": "m1", "kind": "fresh"},
+        {"ts": 5.0, "mono": 10.5, "pid": 7, "event": "gen_done",
+         "trace_id": "m1", "stop_reason": "stop", "output_len": 4,
+         "latency_s": 0.5},
+    ]
+    (rec,) = analyze(evs).records
+    assert rec.clock == "mono"
+    assert rec.span_s == pytest.approx(0.5)
+    assert rec.stages["admission_wait"] == pytest.approx(0.2)
+    # two pids -> wall time is the only shared clock
+    evs[1]["pid"] = 8
+    (rec,) = analyze(evs).records
+    assert rec.clock == "ts"
+
+
+def test_client_only_log_is_opaque():
+    evs = [
+        {"ts": 1.0, "event": "rollout_submit", "trace_id": "c1",
+         "input_len": 4},
+        {"ts": 1.4, "event": "gen_done", "trace_id": "c1",
+         "stop_reason": "stop", "output_len": 4, "latency_s": 0.4},
+    ]
+    rep = analyze(evs)
+    (rec,) = rep.records
+    # no server-side spans to decompose: one opaque stage, identity holds
+    assert rec.stages == pytest.approx({"opaque": 0.4})
+    assert check_accounting(rep.records).ok
+    assert rep.completeness.complete
+
+
+def test_dist_summary_interpolation():
+    d = dist_summary(range(1, 101))
+    assert d["count"] == 100 and d["min"] == 1 and d["max"] == 100
+    assert d["p50"] == pytest.approx(50.5)
+    assert d["p99"] == pytest.approx(99.01)
+    assert dist_summary([]) is None
+    assert dist_summary([float("inf"), float("nan")]) is None
+
+
+# ---------------------------------------------------------------------------
+# completeness linter on fixture logs
+# ---------------------------------------------------------------------------
+
+
+def test_truncated_log_flags_orphans():
+    rep = analyze(os.path.join(TRACES, "truncated.jsonl"))
+    assert not rep.completeness.complete
+    assert rep.completeness.orphan_traces == ["tr-1"]
+
+
+def test_unjoined_resubmit_flagged():
+    rep = analyze(os.path.join(TRACES, "unjoined_resubmit.jsonl"))
+    assert not rep.completeness.complete
+    assert rep.completeness.unjoined_resubmits == 1
+    # ...while a resubmit that follows its original submit joins fine
+    rejoined = [
+        {"ts": 1.0, "event": "rollout_submit", "trace_id": "r1",
+         "input_len": 4},
+        {"ts": 1.2, "event": "resubmit", "trace_id": "r1",
+         "from_server": "s0", "to_server": "s1", "attempt": 2},
+        {"ts": 1.6, "event": "gen_done", "trace_id": "r1",
+         "stop_reason": "stop", "output_len": 4, "latency_s": 0.6},
+    ]
+    rep = analyze(rejoined)
+    assert rep.completeness.complete
+    assert rep.records[0].resubmits == 1
+
+
+def test_meta_trailer_marks_log_lossy():
+    rep = analyze(os.path.join(TRACES, "dropped.jsonl"))
+    assert rep.completeness.dropped_events == 5
+    assert not rep.completeness.complete
+    report = build_report(os.path.join(TRACES, "dropped.jsonl"))
+    assert report["complete"] is False
+    assert report["completeness"]["dropped_events"] == 5
+
+
+def test_open_traces_reported_not_failed_unless_strict():
+    evs = [{"ts": 1.0, "event": "rollout_submit", "trace_id": "o1",
+            "input_len": 4}]
+    rep = analyze(evs)
+    assert rep.completeness.complete and rep.completeness.open_traces == 1
+    assert not analyze(evs, strict_open=True).completeness.complete
+
+
+def test_incomplete_interrupt_on_closed_trace():
+    evs = [
+        {"ts": 1.0, "event": "rollout_submit", "trace_id": "i1",
+         "input_len": 4},
+        {"ts": 1.2, "event": "interrupt", "trace_id": "i1"},
+        {"ts": 1.6, "event": "gen_done", "trace_id": "i1",
+         "stop_reason": "stop", "output_len": 4, "latency_s": 0.6},
+    ]
+    rep = analyze(evs)
+    assert rep.completeness.incomplete_interrupts == 1
+    assert not rep.completeness.complete
+    # a resume between them closes the window
+    evs.insert(2, {"ts": 1.4, "event": "resume", "trace_id": "i1",
+                   "attempt": 1, "generated": 2, "prompt_len": 4})
+    assert analyze(evs).completeness.complete
+
+
+# ---------------------------------------------------------------------------
+# SLO report + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_slo_report_schema_and_markdown():
+    report = build_report(os.path.join(TRACES, "clean.jsonl"), run_id="t")
+    assert report["schema"] == SLO_SCHEMA
+    assert report["complete"] is True
+    assert report["goodput"]["output_tokens"] == 16
+    assert report["e2e_s"]["count"] == 1
+    assert set(report["stages"]) == {"admission_wait", "prefill",
+                                     "decode", "tail"}
+    assert report["staleness"]["p50"] == 1.0
+    md = render_markdown(report)
+    assert "# SLO report t" in md
+    assert "stage:decode" in md and "| end-to-end |" in md
+
+
+def test_slo_cli_writes_artifacts_and_gates(tmp_path):
+    out = tmp_path / "SLO_REPORT_t.json"
+    md = tmp_path / "SLO_REPORT_t.md"
+    rc = slo_main([os.path.join(TRACES, "clean.jsonl"), "--out", str(out),
+                   "--md", str(md), "--run-id", "t", "--require-complete",
+                   "--require-identity"])
+    assert rc == 0
+    assert json.loads(out.read_text())["schema"] == SLO_SCHEMA
+    assert md.read_text().startswith("# SLO report t")
+    # lossy log + --require-complete must gate
+    rc = slo_main([os.path.join(TRACES, "dropped.jsonl"),
+                   "--require-complete"])
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# replay workload generators
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_mixed_deterministic_and_mixed():
+    kw = dict(seed=1, duration_s=12.0, base_rps=4.0,
+              max_prompt_len=128, max_new_tokens=16)
+    a = wl.synthetic_mixed(**kw)
+    b = wl.synthetic_mixed(**kw)
+    assert a == b  # same seed, same workload — curves comparable
+    kinds = {x.kind for x in a}
+    assert kinds == {"chat", "group", "straggler"}
+    assert all(x.t >= 0 and x.prompt_len >= 1 for x in a)
+    assert a != wl.synthetic_mixed(**{**kw, "seed": 2})
+
+
+def test_group_siblings_share_prompts():
+    arrivals = wl.synthetic_mixed(seed=1, duration_s=12.0, base_rps=4.0,
+                                  max_prompt_len=128, max_new_tokens=16)
+    groups = {}
+    for a in arrivals:
+        if a.group_id:
+            groups.setdefault(a.group_id, []).append(
+                wl.prompt_ids(a, vocab=512, seed=1))
+    assert groups
+    for ids in groups.values():
+        assert len(ids) == 4  # group_n siblings
+        assert all(x == ids[0] for x in ids)  # shared prefix material
+
+
+def test_scale_compresses_clock_only():
+    arrivals = wl.synthetic_mixed(seed=1, duration_s=12.0, base_rps=4.0)
+    fast = wl.scale(arrivals, 4.0)
+    assert [a.t / 4.0 for a in arrivals] == pytest.approx(
+        [f.t for f in fast])
+    assert [a.prompt_len for a in arrivals] == [f.prompt_len for f in fast]
+    with pytest.raises(ValueError):
+        wl.scale(arrivals, 0)
+
+
+def test_arrivals_from_trace_roundtrip():
+    arrivals = wl.arrivals_from_trace(os.path.join(TRACES, "clean.jsonl"))
+    (a,) = arrivals
+    assert a.t == 0.0 and a.prompt_len == 8
+    assert a.max_new_tokens == 16  # budget from the recorded gen_done
+    assert a.trace_id == "tr-1" and a.kind == "trace"
+
+
+# ---------------------------------------------------------------------------
+# check_slo regression gate
+# ---------------------------------------------------------------------------
+
+
+def test_check_slo_pass_and_regression():
+    cs = _load_check_slo()
+    report = build_report(os.path.join(TRACES, "clean.jsonl"), run_id="t")
+    baseline = cs.write_baseline(report, None, tolerance=0.5)
+    assert baseline["schema"] == cs.SCHEMA
+    rc, text = cs.run_gate(report, baseline)
+    assert rc == 0 and "PASS" in text
+
+    # 2.5x p99 regression: hard fail, even in CI's --hard-only mode
+    bad = json.loads(json.dumps(report))
+    bad["e2e_s"]["p99"] *= 2.5
+    rc, text = cs.run_gate(bad, baseline)
+    assert rc == 1 and "HARD e2e_s.p99" in text
+    rc, _ = cs.run_gate(bad, baseline, hard_only=True)
+    assert rc == 1
+
+    # 1.6x: outside the soft band (+50%) but under the 2x hard ratio
+    mild = json.loads(json.dumps(report))
+    mild["e2e_s"]["p99"] *= 1.6
+    rc, text = cs.run_gate(mild, baseline)
+    assert rc == 1 and "soft e2e_s.p99" in text
+    rc, _ = cs.run_gate(mild, baseline, hard_only=True)
+    assert rc == 0
+
+    # an incomplete report can never pass, whatever the numbers say
+    lossy = json.loads(json.dumps(report))
+    lossy["completeness"]["complete"] = False
+    rc, text = cs.run_gate(lossy, baseline, hard_only=True)
+    assert rc == 1 and "HARD completeness" in text
+
+
+def test_check_slo_lower_direction_guards_throughput():
+    cs = _load_check_slo()
+    report = {"completeness": {"complete": True}, "accounting": {"ok": True},
+              "goodput": {"output_tokens_per_s": 100.0}}
+    baseline = {"schema": cs.SCHEMA, "hard_fail_ratio": 2.0, "metrics": {
+        "goodput.output_tokens_per_s": {
+            "baseline": 100.0, "tolerance": 0.3, "direction": "lower"}}}
+    assert cs.run_gate(report, baseline)[0] == 0
+    report["goodput"]["output_tokens_per_s"] = 60.0  # -40%: soft band
+    assert cs.run_gate(report, baseline)[0] == 1
+    assert cs.run_gate(report, baseline, hard_only=True)[0] == 0
+    report["goodput"]["output_tokens_per_s"] = 40.0  # <1/2x: hard
+    assert cs.run_gate(report, baseline, hard_only=True)[0] == 1
+
+
+def test_check_slo_cli_against_checked_in_baseline():
+    """The committed baseline must accept the committed report it was
+    written from (CI runs exactly this gate against fresh replay runs)."""
+    report = os.path.join(DATA, "slo_replay_report.json")
+    baseline = os.path.join(DATA, "slo_baseline.json")
+    res = subprocess.run(
+        [sys.executable, CHECK_SLO, "--report", report,
+         "--baseline", baseline],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASS" in res.stdout
+
+    res = subprocess.run(
+        [sys.executable, CHECK_SLO, "--report", report,
+         "--baseline", os.path.join(TRACES, "clean.jsonl")],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 2  # unusable baseline is its own failure
