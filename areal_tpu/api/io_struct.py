@@ -155,6 +155,12 @@ class WeightUpdateMeta:
     # identify the trial for the name_resolve version handshake
     experiment_name: str = ""
     trial_name: str = ""
+    # disk updates only: pin the exact version the servers must load.
+    # None (the default, normal training) lets each server resolve the
+    # newest v{N} snapshot itself; recovery replays set it so rejoining
+    # servers are forced to the RECOVERED version even when a newer,
+    # never-trained-on snapshot survived the crash on disk.
+    version: Optional[int] = None
 
     @classmethod
     def from_disk(

@@ -293,3 +293,22 @@ class WorkflowExecutor:
 
     def is_paused(self) -> bool:
         return self.runner.paused.is_set()
+
+    # --- crash recovery (utils/recover.py) ---
+    def restore_staleness(self, stat) -> int:
+        """Adopt a recovered ledger snapshot.  Trajectories that were in
+        flight when the trainer died are settled as rejected by the
+        manager and surfaced here as lost — same accounting as a
+        failover-budget exhaustion, so loss fractions stay honest across
+        restarts.  Returns the number settled."""
+        settled = self.staleness_manager.restore(stat)
+        if settled:
+            self.lost_trajectories += settled
+            if telemetry.is_enabled():
+                telemetry.emit(
+                    "trajectory_lost",
+                    lost_total=self.lost_trajectories,
+                    reason="trainer_crash",
+                    settled=settled,
+                )
+        return settled

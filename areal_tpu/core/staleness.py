@@ -81,6 +81,25 @@ class StalenessManager:
         with self._lock:
             return RolloutStat(**asdict(self._stat))
 
+    def restore(self, stat: RolloutStat) -> int:
+        """Adopt a ledger snapshot from a recover manifest (ISSUE 15).
+
+        Trajectories that were in flight when the trainer died can never
+        settle through their futures — those are gone with the process —
+        so they are folded into `rejected` here, which keeps the invariant
+        checkable from the first post-restore transition.  Returns how
+        many were settled that way (the caller counts them as lost)."""
+        settled = max(0, stat.running)
+        with self._lock:
+            self._stat = RolloutStat(
+                submitted=stat.submitted,
+                accepted=stat.accepted,
+                rejected=stat.rejected + settled,
+                running=0,
+            )
+            self._check_locked()
+        return settled
+
     def register_metrics(self, reg=None) -> None:
         """Expose submitted/accepted/running as scrape-time gauges.
 

@@ -81,11 +81,17 @@ class JaxBackend:
         self, meta: WeightUpdateMeta
     ) -> WeightUpdateRequests:
         if meta.type == "disk":
+            payload: Dict[str, Any] = {"path": meta.path}
+            # recovery replays pin the version (see WeightUpdateMeta.version);
+            # the server loads exactly path/v{version} instead of the newest
+            # snapshot, which may postdate the recovered checkpoint
+            if meta.version is not None:
+                payload["version"] = int(meta.version)
             return WeightUpdateRequests(
                 requests=[
                     HttpRequest(
                         endpoint="/update_weights_from_disk",
-                        payload={"path": meta.path},
+                        payload=payload,
                     )
                 ]
             )

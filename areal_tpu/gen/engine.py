@@ -907,13 +907,24 @@ class GenEngine:
             if aborted:
                 logger.info(f"aborted {aborted} requests for weight update")
             if params is None:
+                import os
+
                 assert path is not None
-                path, dir_version = self._resolve_ckpt_dir(path)
-                if version is None:
-                    # adopt the trainer's version from the v{N} dir name — a
-                    # fresh server must not restart its version counter at 1
-                    # while the trainer is at N (staleness gates compare them)
-                    version = dir_version
+                pinned = os.path.join(path, f"v{int(version)}") \
+                    if version is not None else None
+                if pinned is not None and os.path.isdir(pinned):
+                    # recovery replays pin the version: load exactly that
+                    # snapshot, not the newest — a later, never-trained-on
+                    # v{N} may have survived the crash on disk
+                    path = pinned
+                else:
+                    path, dir_version = self._resolve_ckpt_dir(path)
+                    if version is None:
+                        # adopt the trainer's version from the v{N} dir name
+                        # — a fresh server must not restart its version
+                        # counter at 1 while the trainer is at N (staleness
+                        # gates compare them)
+                        version = dir_version
                 params, _ = load_hf_params(path, self.model_config, dtype="bfloat16")
             self.swap_weights_live(params, version=version)
         finally:

@@ -8,6 +8,18 @@ and relaunch the whole run on failure (auto-recover loop,
 RECOVER_TIME_INTERVAL) up to `recover.retries` times with AREAL_RUN_ID
 incremented so `check_if_recover` (utils/recover.py) resumes from the dump.
 
+Two relaunch classes (ISSUE 15):
+- crash (any unexpected rc): consumes one of `recover.retries`, waits out
+  RECOVER_TIME_INTERVAL — the dump on disk is whatever the dying process
+  last committed;
+- preemption (rc == RESUME_EXIT_CODE, utils/shutdown.py): the trainer
+  announced an orderly retreat with a known-good force-dump, so the
+  relaunch is immediate and does NOT burn a crash retry.
+
+Either way AREAL_RUN_ID increments per launch, so run artifacts
+(events_run{N}.jsonl, logs) never collide and `check_if_recover`'s
+``fault`` mode sees a relaunch.
+
 Usage:
     python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v ...]
 """
@@ -22,10 +34,14 @@ from typing import Dict, List, Optional
 from areal_tpu.api.alloc import AllocationMode
 from areal_tpu.api.config import GRPOConfig, load_expr_config
 from areal_tpu.utils import logging, name_resolve, names, network
+from areal_tpu.utils.shutdown import RESUME_EXIT_CODE
 
 logger = logging.getLogger("launcher.local")
 
 RECOVER_TIME_INTERVAL = 10.0
+# brief pause before a preemption relaunch: lets sockets/ports settle
+# without hot-spinning if the entry exits with the resume code instantly
+RESUME_RELAUNCH_DELAY = 1.0
 
 
 class LocalLauncher:
@@ -116,9 +132,10 @@ class LocalLauncher:
 
         retries = max(1, self.config.recover.retries)
         run_id = int(os.environ.get("AREAL_RUN_ID", 0))
+        failures = 0  # crash relaunches consumed; preemptions don't count
         rc = 1
         try:
-            while run_id < retries:
+            while True:
                 self.server_addrs = self.start_gen_servers(n_servers)
                 trainer = self.start_trainer(self.server_addrs, run_id)
                 rc = self._babysit(trainer)
@@ -126,11 +143,26 @@ class LocalLauncher:
                 if rc == 0:
                     logger.info("trainer finished successfully")
                     return 0
+                if self.config.recover.mode == "disabled":
+                    return rc
                 run_id += 1
-                if run_id < retries and self.config.recover.mode in ("auto", "fault"):
+                if rc == RESUME_EXIT_CODE:
+                    # orderly preemption retreat (utils/shutdown.py): the
+                    # dump is known-good — relaunch now, keep the retry
+                    # budget for real crashes
+                    logger.warning(
+                        f"trainer preempted (rc={rc}); relaunching "
+                        f"immediately (run {run_id})"
+                    )
+                    time.sleep(RESUME_RELAUNCH_DELAY)
+                    continue
+                failures += 1
+                if failures < retries and self.config.recover.mode in (
+                        "auto", "fault"):
                     logger.warning(
                         f"trainer exited rc={rc}; relaunching (run {run_id}) "
-                        f"in {RECOVER_TIME_INTERVAL}s"
+                        f"in {RECOVER_TIME_INTERVAL}s "
+                        f"[crash {failures}/{retries}]"
                     )
                     time.sleep(RECOVER_TIME_INTERVAL)
                 else:

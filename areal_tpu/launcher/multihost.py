@@ -35,10 +35,14 @@ from typing import Callable, Dict, List, Optional
 from areal_tpu.api.alloc import AllocationMode
 from areal_tpu.api.config import GRPOConfig, load_expr_config
 from areal_tpu.utils import logging, network
+from areal_tpu.utils.shutdown import RESUME_EXIT_CODE
 
 logger = logging.getLogger("launcher.multihost")
 
 RECOVER_TIME_INTERVAL = 10.0
+# immediate-relaunch pause after an orderly preemption exit (ssh
+# propagates the remote trainer's RESUME_EXIT_CODE as its own status)
+RESUME_RELAUNCH_DELAY = 1.0
 COORDINATOR_PORT_BASE = 20000
 
 
@@ -196,9 +200,10 @@ class MultiHostLauncher:
     def run(self) -> int:
         retries = max(1, self.config.recover.retries)
         run_id = int(os.environ.get("AREAL_RUN_ID", 0))
+        failures = 0  # crash relaunches consumed; preemptions don't count
         rc = 1
         try:
-            while run_id < retries:
+            while True:
                 self.start_gen_servers()
                 trainers = self.start_trainers(run_id)
                 rc = self._babysit(trainers)
@@ -206,11 +211,25 @@ class MultiHostLauncher:
                 if rc == 0:
                     logger.info("all trainer processes finished successfully")
                     return 0
+                if self.config.recover.mode == "disabled":
+                    return rc
                 run_id += 1
-                if run_id < retries and self.config.recover.mode in ("auto", "fault"):
+                if rc == RESUME_EXIT_CODE:
+                    # orderly preemption (utils/shutdown.py): known-good
+                    # dump on the shared filesystem — relaunch now without
+                    # burning a crash retry
+                    logger.warning(
+                        f"trainer preempted (rc={rc}); relaunching "
+                        f"immediately (run {run_id})"
+                    )
+                    time.sleep(RESUME_RELAUNCH_DELAY)
+                    continue
+                failures += 1
+                if failures < retries and self.config.recover.mode in (
+                        "auto", "fault"):
                     logger.warning(
                         f"run failed rc={rc}; relaunching (run {run_id}) in "
-                        f"{RECOVER_TIME_INTERVAL}s"
+                        f"{RECOVER_TIME_INTERVAL}s [crash {failures}/{retries}]"
                     )
                     time.sleep(RECOVER_TIME_INTERVAL)
                 else:

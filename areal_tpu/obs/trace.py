@@ -56,9 +56,12 @@ _REQUIRES_ROOT = (
     "prefill", "resume", "resubmit", "interrupt", "reward",
     "gen_done", "rollout_lost",
 )
-# Global (traceless) events: never orphan candidates.
+# Global (traceless) events: never orphan candidates.  run_restart marks
+# a trainer relaunch resuming from a recover generation (utils/recover.py)
+# — the boundary event a stitched multi-run log must carry to stay
+# complete.
 _GLOBAL_EVENTS = (
-    "pause", "episode", "trajectory_lost", "telemetry_meta",
+    "pause", "episode", "trajectory_lost", "telemetry_meta", "run_restart",
 )
 
 EventSource = Union[str, Iterable[Dict[str, Any]]]
@@ -173,6 +176,10 @@ class TraceReport:
     pauses: List[Dict[str, Any]]
     chunk_latency_by_tier: Dict[int, List[float]]
     wall_span_s: float
+    # run_restart boundary events (utils/recover.py): one per trainer
+    # relaunch that resumed from a recover generation — the seam where a
+    # stitched multi-run log changes pid
+    restarts: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     @property
     def closed(self) -> List[TrajectoryRecord]:
@@ -342,6 +349,7 @@ def analyze(source: EventSource, *, strict_open: bool = False,
     by_key: Dict[int, str] = {}
     submit_seen: set = set()
     pauses: List[Dict[str, Any]] = []
+    restarts: List[Dict[str, Any]] = []
     chunk_by_tier: Dict[int, List[float]] = {}
     unmatched_consumes = 0
     for e in events:
@@ -351,6 +359,9 @@ def analyze(source: EventSource, *, strict_open: bool = False,
             continue
         if name == "pause":
             pauses.append(e)
+            continue
+        if name == "run_restart":
+            restarts.append(e)
             continue
         if name == "train_consume":
             tid = by_key.get(e.get("trace_key"))
@@ -421,7 +432,8 @@ def analyze(source: EventSource, *, strict_open: bool = False,
     wall = [float(e["ts"]) for e in events if "ts" in e]
     span = (max(wall) - min(wall)) if wall else 0.0
     return TraceReport(records=records, completeness=comp, pauses=pauses,
-                       chunk_latency_by_tier=chunk_by_tier, wall_span_s=span)
+                       chunk_latency_by_tier=chunk_by_tier, wall_span_s=span,
+                       restarts=restarts)
 
 
 @dataclasses.dataclass

@@ -25,12 +25,25 @@ Wiring: tests/fake_server.py consults ``fault_plan.decide(endpoint)`` at
 the top of each handler; `apply_fault` turns the decision into aiohttp
 behavior.  ``scripts/bench_e2e_grpo.py --chaos`` mounts a `FaultProxy`
 in front of a real gen server so the same plans drive real engines.
+
+Trainer-kill chaos (ISSUE 15) extends the vocabulary past the transport:
+named **fault points** are markers compiled into crash-critical code
+paths (``fault_point("train_step")`` at the end of each train step,
+``fault_point("recover_mid_dump")`` between a checkpoint's staging and
+its atomic rename).  Arming one — in-process via `arm_fault_point` or
+from outside via the ``AREAL_FAULT_POINTS`` env var — makes the Nth hit
+either SIGKILL the process (no flush, no goodbye: the preemption/OOM
+fault the transport plans cannot express) or raise `InjectedFault` (the
+in-process variant for unit tests).  Unarmed points cost a dict lookup.
 """
 
 import asyncio
 import json
+import os
 import random
+import signal
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -161,12 +174,92 @@ async def apply_fault(fault: Optional[Fault], request):
     raise ValueError(f"unknown fault kind {fault.kind!r}")
 
 
+class InjectedFault(RuntimeError):
+    """Raised by a fault point armed with action='raise' (the in-process
+    stand-in for a kill when the test wants to keep its interpreter)."""
+
+
+# {name: {"action": "kill"|"raise", "at_hit": int, "hits": int}}
+_FAULT_POINTS: Dict[str, Dict] = {}
+_FAULT_LOCK = threading.Lock()
+_ENV_PARSED = False
+
+FAULT_POINT_ACTIONS = ("kill", "raise")
+
+
+def arm_fault_point(name: str, action: str = "kill", at_hit: int = 1) -> None:
+    """Arm `name` to fire on its `at_hit`-th hit.  `kill` SIGKILLs the
+    process (crash-for-real, subprocess harnesses); `raise` throws
+    `InjectedFault` (in-process unit tests)."""
+    if action not in FAULT_POINT_ACTIONS:
+        raise ValueError(f"unknown fault-point action {action!r}")
+    if at_hit < 1:
+        raise ValueError(f"at_hit must be >= 1, got {at_hit}")
+    with _FAULT_LOCK:
+        _FAULT_POINTS[name] = {"action": action, "at_hit": at_hit, "hits": 0}
+
+
+def reset_fault_points() -> None:
+    """Disarm everything and forget the env parse (tests)."""
+    global _ENV_PARSED
+    with _FAULT_LOCK:
+        _FAULT_POINTS.clear()
+        _ENV_PARSED = False
+
+
+def kill_trainer_at_step(step: int, start_step: int = 0) -> None:
+    """Arm the ``train_step`` point so the process is SIGKILLed at the end
+    of absolute step `step` (the chaos-harness entry: step counting is
+    relative to `start_step`, so a relaunched run arms against its own
+    resume point)."""
+    arm_fault_point("train_step", action="kill",
+                    at_hit=step - start_step + 1)
+
+
+def _parse_env_fault_points() -> None:
+    """``AREAL_FAULT_POINTS="name[@N][:action],..."`` — arm points from the
+    environment so subprocess harnesses (bench, CI) need no code hook.
+    Parsed once, lazily, at the first fault_point() hit."""
+    global _ENV_PARSED
+    _ENV_PARSED = True
+    spec = os.environ.get("AREAL_FAULT_POINTS", "")
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        action = "kill"
+        if ":" in item:
+            item, action = item.rsplit(":", 1)
+        at_hit = 1
+        if "@" in item:
+            item, n = item.rsplit("@", 1)
+            at_hit = int(n)
+        _FAULT_POINTS[item] = {"action": action, "at_hit": at_hit, "hits": 0}
+
+
+def fault_point(name: str) -> None:
+    """A named crash marker.  No-op unless armed; on the armed hit either
+    SIGKILLs the process or raises `InjectedFault`."""
+    with _FAULT_LOCK:
+        if not _ENV_PARSED:
+            _parse_env_fault_points()
+        entry = _FAULT_POINTS.get(name)
+        if entry is None:
+            return
+        entry["hits"] += 1
+        if entry["hits"] != entry["at_hit"]:
+            return
+        action = entry["action"]
+    if action == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        # SIGKILL is asynchronous: never let execution proceed past the
+        # crash point while delivery is pending
+        while True:
+            time.sleep(1.0)
+    raise InjectedFault(name)
+
+
 def kill_process(proc, timeout: float = 10.0) -> Optional[int]:
     """SIGKILL a real gen-server subprocess and reap it — the one fault an
     in-process injector cannot express (no flush, no goodbye, exactly like
     an OOM-killed or preempted fleet member)."""
-    import signal
-
     if proc.poll() is None:
         try:
             proc.send_signal(signal.SIGKILL)

@@ -1,23 +1,107 @@
-"""Checkpoint/resume for interrupted experiments.
+"""Crash-safe checkpoint/resume for interrupted experiments (ISSUE 15).
 
 Behavioral counterpart of the reference's `RecoverHandler`
-(areal/utils/recover.py:139): dump = engine checkpoint with optimizer state
-+ dataloader position + saver/evaluator/stats-logger state + RecoverInfo;
-load = restore all of it and replay the weight upload to (fresh) inference
-servers; `check_if_recover` (:373) decides whether a run should resume.
+(areal/utils/recover.py:139), hardened so the trainer can die at ANY
+instant — SIGKILL mid-dump included — and the relaunch resumes from the
+last-known-good state losing at most one step:
+
+- `dump` stages the whole checkpoint into ``recover/.tmp-<step>``, fsyncs
+  every file, writes a JSON manifest (step, weight version, config
+  fingerprint, per-file digests, async rollout state), then atomically
+  renames the staging dir to ``recover/gen-<step>``.  The previous
+  generation is retained until the new one is durable, so there is never
+  a moment without an intact checkpoint on disk.
+- `load` walks generations newest-first, validates each manifest (parse,
+  per-file size + blake2b digest), and falls back to the previous
+  generation on a torn or tampered one.  A config-fingerprint mismatch is
+  refused outright (`RecoverConfigMismatch`) — silently resuming under a
+  different config corrupts the run worse than starting over.
+- Async state rides in the manifest: the staleness ledger snapshot, the
+  seeding base, and the fleet weight version.  On load the weight upload
+  is replayed with the version PINNED so rejoining gen servers serve the
+  recovered policy (not a newer snapshot that survived the crash), and
+  in-flight-at-crash trajectories are settled as rejected — the ledger
+  invariant holds and the loss is counted in telemetry.
+
+`check_if_recover` (reference :373) decides whether a launch resumes;
+mode ``resume`` now *raises* when no checkpoint exists instead of
+silently starting fresh.
 """
 
+import hashlib
 import json
 import os
 import pickle
-from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, Optional
+import re
+import shutil
+import time
+from dataclasses import asdict, dataclass, field, replace
+from typing import Any, Dict, List, Optional
 
 from areal_tpu.api.config import RecoverConfig
-from areal_tpu.api.io_struct import SaveLoadMeta, StepInfo, WeightUpdateMeta
-from areal_tpu.utils import logging
+from areal_tpu.api.io_struct import (
+    RolloutStat,
+    SaveLoadMeta,
+    StepInfo,
+    WeightUpdateMeta,
+)
+from areal_tpu.utils import logging, telemetry
+from areal_tpu.utils.faults import fault_point
 
 logger = logging.getLogger("recover")
+
+MANIFEST_SCHEMA = "areal-recover/v1"
+# generations kept on disk: the live one + the fallback
+KEEP_GENERATIONS = 2
+
+_GEN_RE = re.compile(r"gen-(\d{8})")
+
+
+class RecoverCorruptError(RuntimeError):
+    """A generation failed manifest validation (torn rename, tampered or
+    truncated file).  `load` falls back to the previous generation."""
+
+
+class RecoverConfigMismatch(RuntimeError):
+    """The checkpoint was written under a different config fingerprint.
+    Refused, never fallen back from — resuming a run under different
+    hyperparameters silently corrupts it."""
+
+
+def config_fingerprint(obj: Any) -> str:
+    """Stable fingerprint of a config: blake2b over the canonical JSON of
+    its dict form.  Non-serializable leaves degrade to repr() so the
+    fingerprint stays total over dataclass trees."""
+    blob = json.dumps(obj, sort_keys=True, default=repr).encode("utf-8")
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_path(path: str) -> None:
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0) \
+        if os.path.isdir(path) else os.O_RDONLY
+    try:
+        fd = os.open(path, flags)
+    except OSError:  # e.g. O_DIRECTORY unsupported — durability best-effort
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: str) -> None:
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            _fsync_path(os.path.join(dirpath, fn))
+        _fsync_path(dirpath)
 
 
 @dataclass
@@ -35,9 +119,13 @@ class RecoverInfo:
 
 
 class RecoverHandler:
-    def __init__(self, config: RecoverConfig, ft_spec=None):
+    def __init__(self, config: RecoverConfig, ft_spec=None,
+                 fingerprint: Optional[str] = None):
         self.config = config
         self.ft_spec = ft_spec
+        # config fingerprint stamped into every manifest; load refuses a
+        # generation written under a different one
+        self.fingerprint = fingerprint
 
     def recover_root(self) -> str:
         return os.path.join(
@@ -47,8 +135,27 @@ class RecoverHandler:
             "recover",
         )
 
-    def _info_path(self) -> str:
-        return os.path.join(self.recover_root(), "recover_info.pkl")
+    # ------------------------------------------------------------------
+    # generation discovery
+    # ------------------------------------------------------------------
+
+    def generations(self) -> List[str]:
+        """Completed generation dirs, oldest-first.  Staging dirs
+        (``.tmp-*``) are invisible here by construction: only the atomic
+        rename makes a generation discoverable."""
+        root = self.recover_root()
+        if not os.path.isdir(root):
+            return []
+        out = []
+        for d in os.listdir(root):
+            m = _GEN_RE.fullmatch(d)
+            if m:
+                out.append((int(m.group(1)), os.path.join(root, d)))
+        return [p for _, p in sorted(out)]
+
+    # ------------------------------------------------------------------
+    # dump
+    # ------------------------------------------------------------------
 
     def dump(
         self,
@@ -60,15 +167,25 @@ class RecoverHandler:
         dataloader=None,
         tokenizer=None,
         extra_engines=None,  # {"critic": engine, ...} — saved beside the main one
+        inference_engine=None,  # snapshot its staleness ledger + fleet version
     ) -> str:
         root = self.recover_root()
-        ckpt = os.path.join(root, "checkpoint")
+        os.makedirs(root, exist_ok=True)
+        step = step_info.global_step
+        staging = os.path.join(root, f".tmp-{step:08d}")
+        final = os.path.join(root, f"gen-{step:08d}")
+        for stale in (staging, final):
+            if os.path.isdir(stale):
+                shutil.rmtree(stale)
+
+        ckpt = os.path.join(staging, "checkpoint")
         os.makedirs(ckpt, exist_ok=True)
         engine.save(SaveLoadMeta(path=ckpt, with_optim=True, tokenizer=tokenizer))
         for name, eng in (extra_engines or {}).items():
-            sub = os.path.join(root, f"checkpoint_{name}")
+            sub = os.path.join(staging, f"checkpoint_{name}")
             os.makedirs(sub, exist_ok=True)
             eng.save(SaveLoadMeta(path=sub, with_optim=True, tokenizer=tokenizer))
+
         info = RecoverInfo(
             recover_start=StepInfo(
                 epoch=step_info.epoch,
@@ -82,14 +199,155 @@ class RecoverHandler:
             stats_logger_info=stats_logger.state_dict() if stats_logger else {},
             dataloader_info=dataloader.state_dict() if dataloader else {},
         )
-        with open(self._info_path(), "wb") as f:
+        # state dicts may hold non-JSON leaves (rng state, tensors) — they
+        # stay pickled; everything human-relevant lives in the manifest
+        with open(os.path.join(staging, "recover_state.pkl"), "wb") as f:
             pickle.dump(info, f)
-        with open(os.path.join(root, "recover_info.json"), "w") as f:
-            json.dump(
-                {"last_step_info": asdict(info.last_step_info)}, f
+
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "recover_start": asdict(info.recover_start),
+            "last_step_info": asdict(info.last_step_info),
+            "weight_version": self._maybe_version(engine),
+            "run_id": int(os.environ.get("AREAL_RUN_ID", 0)),
+            "created_ts": time.time(),
+            "config_fingerprint": self.fingerprint,
+            "extra_engines": sorted((extra_engines or {}).keys()),
+            "async_state": self._async_state(inference_engine),
+            "files": {},
+        }
+        for dirpath, _dn, filenames in os.walk(staging):
+            for fn in filenames:
+                p = os.path.join(dirpath, fn)
+                rel = os.path.relpath(p, staging)
+                manifest["files"][rel] = {
+                    "size": os.path.getsize(p),
+                    "blake2b": _file_digest(p),
+                }
+        with open(os.path.join(staging, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+        _fsync_tree(staging)
+
+        # chaos hook: a SIGKILL here leaves only a .tmp-* dir behind —
+        # invisible to load(), which keeps serving the previous generation
+        fault_point("recover_mid_dump")
+
+        os.rename(staging, final)  # the commit point: atomic on one FS
+        _fsync_path(root)
+        self._prune()
+        self._write_sidecar(manifest, final)  # after prune: reflects disk
+        logger.info(f"dumped recover generation @ step {step} -> {final}")
+        return final
+
+    @staticmethod
+    def _maybe_version(engine) -> Optional[int]:
+        try:
+            return int(engine.get_version())
+        except (AttributeError, TypeError):
+            return None
+
+    @staticmethod
+    def _async_state(inference_engine) -> Dict[str, Any]:
+        """Snapshot of the async side: staleness ledger, seed base, fleet
+        weight version.  All best-effort — a dump must never fail because
+        the rollout side is degraded."""
+        state: Dict[str, Any] = {
+            "rollout_stat": None,
+            "seed": None,
+            "fleet_weight_version": None,
+        }
+        from areal_tpu.utils import seeding
+
+        try:
+            state["seed"] = seeding.get_seed()
+        except RuntimeError:
+            pass
+        if inference_engine is None:
+            return state
+        executor = getattr(inference_engine, "executor", None)
+        if executor is not None:
+            state["rollout_stat"] = asdict(
+                executor.staleness_manager.get_stats()
             )
-        logger.info(f"dumped recover checkpoint @ step {step_info.global_step}")
-        return root
+        try:
+            state["fleet_weight_version"] = int(inference_engine.get_version())
+        except (AttributeError, TypeError):
+            pass
+        return state
+
+    def _write_sidecar(self, manifest: Dict[str, Any], latest: str) -> None:
+        """Human-readable ``recover_info.json`` beside the generations:
+        the full manifest summary, not just last_step_info (ISSUE 15
+        satellite).  Written tmp+rename so it is itself crash-safe."""
+        root = self.recover_root()
+        gens = self.generations()
+        doc = {
+            "schema": MANIFEST_SCHEMA,
+            "experiment_name": self.config.experiment_name,
+            "trial_name": self.config.trial_name,
+            "run_id": manifest["run_id"],
+            "last_step_info": manifest["last_step_info"],
+            "recover_start": manifest["recover_start"],
+            "weight_version": manifest["weight_version"],
+            "config_fingerprint": manifest["config_fingerprint"],
+            "updated_ts": time.time(),
+            "latest": latest,
+            "generations": gens,
+        }
+        tmp = os.path.join(root, ".recover_info.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, os.path.join(root, "recover_info.json"))
+
+    def _prune(self) -> None:
+        gens = self.generations()
+        for old in gens[:-KEEP_GENERATIONS]:
+            shutil.rmtree(old, ignore_errors=True)
+        # staging leftovers from crashed dumps are dead weight
+        root = self.recover_root()
+        for d in os.listdir(root):
+            if d.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # load
+    # ------------------------------------------------------------------
+
+    def _validate_generation(self, gen_dir: str) -> Dict[str, Any]:
+        mpath = os.path.join(gen_dir, "manifest.json")
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise RecoverCorruptError(f"{gen_dir}: unreadable manifest: {e}")
+        if manifest.get("schema") != MANIFEST_SCHEMA:
+            raise RecoverCorruptError(
+                f"{gen_dir}: unknown manifest schema "
+                f"{manifest.get('schema')!r}"
+            )
+        for rel, spec in manifest.get("files", {}).items():
+            p = os.path.join(gen_dir, rel)
+            if not os.path.isfile(p):
+                raise RecoverCorruptError(f"{gen_dir}: missing file {rel}")
+            if os.path.getsize(p) != spec["size"]:
+                raise RecoverCorruptError(
+                    f"{gen_dir}: size mismatch for {rel}"
+                )
+            if _file_digest(p) != spec["blake2b"]:
+                raise RecoverCorruptError(
+                    f"{gen_dir}: digest mismatch for {rel}"
+                )
+        fp = manifest.get("config_fingerprint")
+        if self.fingerprint is not None and fp is not None \
+                and fp != self.fingerprint:
+            raise RecoverConfigMismatch(
+                f"{gen_dir} was written under config fingerprint {fp}, "
+                f"this run has {self.fingerprint}; refusing to resume — "
+                f"move the recover dir aside or fix the config"
+            )
+        return manifest
 
     def load(
         self,
@@ -102,17 +360,30 @@ class RecoverHandler:
         weight_update_meta: Optional[WeightUpdateMeta] = None,
         extra_engines=None,  # same mapping as dump(); loaded when present
     ) -> Optional[RecoverInfo]:
-        """Restore everything; if an inference engine is given, replay the
-        weight upload so fresh servers serve the recovered policy."""
-        path = self._info_path()
-        if not os.path.exists(path):
+        """Restore from the newest INTACT generation; torn/corrupt ones are
+        skipped with a warning.  If an inference engine is given, the
+        weight upload is replayed with the recovered version pinned so
+        fresh servers serve the recovered policy, and the staleness ledger
+        is restored with in-flight-at-crash trajectories settled as
+        rejected."""
+        manifest = None
+        gen_dir = None
+        for cand in reversed(self.generations()):
+            try:
+                manifest = self._validate_generation(cand)
+                gen_dir = cand
+                break
+            except RecoverCorruptError as e:
+                logger.warning(f"skipping corrupt recover generation: {e}")
+        if gen_dir is None:
             return None
-        with open(path, "rb") as f:
+
+        with open(os.path.join(gen_dir, "recover_state.pkl"), "rb") as f:
             info: RecoverInfo = pickle.load(f)
-        ckpt = os.path.join(self.recover_root(), "checkpoint")
+        ckpt = os.path.join(gen_dir, "checkpoint")
         engine.load(SaveLoadMeta(path=ckpt, with_optim=True))
         for name, eng in (extra_engines or {}).items():
-            sub = os.path.join(self.recover_root(), f"checkpoint_{name}")
+            sub = os.path.join(gen_dir, f"checkpoint_{name}")
             if os.path.isdir(sub):
                 eng.load(SaveLoadMeta(path=sub, with_optim=True))
             else:
@@ -130,29 +401,79 @@ class RecoverHandler:
             dataloader.load_state_dict(info.dataloader_info)
         version = info.last_step_info.global_step + 1
         engine.set_version(version)
+        settled = 0
         if inference_engine is not None and weight_update_meta is not None:
-            engine.update_weights(weight_update_meta)
-            inference_engine.update_weights(weight_update_meta)
+            # pin the version: gen servers must be force-reloaded to the
+            # RECOVERED policy, not whatever newer snapshot survived the
+            # crash on disk (see WeightUpdateMeta.version)
+            pinned = replace(weight_update_meta, version=version) \
+                if weight_update_meta.type == "disk" else weight_update_meta
+            engine.update_weights(pinned)
+            inference_engine.update_weights(pinned)
             inference_engine.set_version(version)
+        if inference_engine is not None:
+            settled = self._restore_async_state(inference_engine, manifest)
+        telemetry.TRAIN_RECOVER.inc()
+        telemetry.emit(
+            "run_restart",
+            run_id=int(os.environ.get("AREAL_RUN_ID", 0)),
+            recovered_step=info.last_step_info.global_step,
+            resume_step=info.recover_start.global_step,
+            weight_version=version,
+            settled_inflight=settled,
+            generation=gen_dir,
+        )
         logger.info(
-            f"recovered from step {info.last_step_info.global_step}; "
-            f"resuming at {info.recover_start.global_step}"
+            f"recovered from step {info.last_step_info.global_step} "
+            f"({gen_dir}); resuming at {info.recover_start.global_step}"
         )
         return info
+
+    @staticmethod
+    def _restore_async_state(inference_engine, manifest: Dict[str, Any]) -> int:
+        executor = getattr(inference_engine, "executor", None)
+        stat = (manifest.get("async_state") or {}).get("rollout_stat")
+        if executor is None or stat is None:
+            return 0
+        settled = executor.restore_staleness(RolloutStat(**stat))
+        if settled:
+            logger.warning(
+                f"settled {settled} in-flight-at-crash trajectories as "
+                f"rejected (counted in lost_trajectories)"
+            )
+        return settled
 
 
 def check_if_recover(config: RecoverConfig, run_id: int = 0) -> bool:
     """Should this launch resume from a recover checkpoint?
-    (reference: recover.py:373)"""
+    (reference: recover.py:373)
+
+    - ``disabled``: never.
+    - ``auto``: resume iff an intact-looking generation exists.
+    - ``resume``: the user EXPLICITLY asked to continue — a missing
+      checkpoint is an error, not a silent fresh start.
+    - ``fault``: resume only on a relaunch (run_id > 0), the launcher's
+      crash-recovery loop.
+    """
     if config.mode == "disabled":
         return False
-    info_path = os.path.join(
-        config.fileroot, config.experiment_name, config.trial_name,
-        "recover", "recover_info.pkl",
+    root = os.path.join(
+        config.fileroot, config.experiment_name, config.trial_name, "recover"
     )
-    exists = os.path.exists(info_path)
+    exists = False
+    if os.path.isdir(root):
+        exists = any(
+            _GEN_RE.fullmatch(d)
+            and os.path.isfile(os.path.join(root, d, "manifest.json"))
+            for d in os.listdir(root)
+        )
     if config.mode == "resume":
-        return exists
+        if not exists:
+            raise FileNotFoundError(
+                f"recover.mode='resume' but no recover generation exists "
+                f"under {root}"
+            )
+        return True
     if config.mode == "auto":
         return exists
     if config.mode == "fault":

@@ -1,7 +1,12 @@
 """Frequency-controlled HF-format checkpoint saving
-(reference: areal/utils/saver.py `Saver`)."""
+(reference: areal/utils/saver.py `Saver`).
+
+Saves are staged + renamed (ISSUE 15): a crash mid-save leaves a
+``.tmp-*`` sibling, never a half-written checkpoint at the published
+path a later run (or a human) would trust."""
 
 import os
+import shutil
 from typing import Optional
 
 from areal_tpu.api.config import SaverConfig
@@ -54,12 +59,19 @@ class Saver:
             steps_per_epoch=self.ft_spec.steps_per_epoch if self.ft_spec else 0,
         )
         path = self.save_path(step_info, name)
-        os.makedirs(path, exist_ok=True)
+        staging = os.path.join(
+            os.path.dirname(path), f".tmp-{os.path.basename(path)}"
+        )
+        for stale in (staging, path):
+            if os.path.isdir(stale):
+                shutil.rmtree(stale)
+        os.makedirs(staging, exist_ok=True)
         engine.save(SaveLoadMeta(
-            path=path,
+            path=staging,
             with_optim=self.for_recover if with_optim is None else with_optim,
             tokenizer=tokenizer,
         ))
+        os.rename(staging, path)  # atomic publish on one filesystem
         logger.info(f"saved checkpoint: {path}")
         return path
 

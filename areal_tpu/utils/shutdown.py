@@ -1,0 +1,112 @@
+"""Preemption-aware shutdown for train loops (ISSUE 15).
+
+Cloud schedulers and cluster managers announce eviction with SIGTERM (and
+operators with Ctrl-C / SIGINT) some grace period before the SIGKILL.
+`PreemptionGuard` converts that signal into a flag the train loop polls at
+its step boundary; `preempt_exit` then performs the orderly retreat:
+
+    pause rollout submission -> interrupt in-flight generation ->
+    force-dump a recover generation -> exit(RESUME_EXIT_CODE)
+
+`RESUME_EXIT_CODE` (75, EX_TEMPFAIL: "temporary failure, retry") is the
+contract with the launchers' relaunch loop (launcher/local.py,
+launcher/multihost.py): a trainer exiting with it is relaunched
+immediately with the next ``AREAL_RUN_ID`` — it does not consume a
+crash-retry and does not wait out the crash backoff, because the dump is
+known-good rather than whatever a dying process left behind.
+
+The guard flips a flag instead of raising from the handler on purpose:
+a signal raised mid-XLA-dispatch or mid-checkpoint would tear exactly the
+state the dump is about to protect.  The second signal is left on the
+default disposition, so a stuck dump can still be interrupted.
+"""
+
+import signal
+import sys
+import threading
+from typing import Optional
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("shutdown")
+
+# os.EX_TEMPFAIL — distinct from both success (0) and crash (anything
+# else): the launcher relaunches it immediately without burning a retry
+RESUME_EXIT_CODE = 75
+
+
+class PreemptionGuard:
+    """SIGTERM/SIGINT -> a step-boundary flag.
+
+    Usage::
+
+        guard = PreemptionGuard().install()
+        for step in range(start, total):
+            ...train one step...
+            if guard.requested:
+                preempt_exit(recover, engine, step_info, ...)
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = signals
+        self._flag = threading.Event()
+        self.signum: Optional[int] = None
+        self._prev = {}
+
+    def install(self) -> "PreemptionGuard":
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+
+    def _on_signal(self, signum, frame):
+        self.signum = signum
+        self._flag.set()
+        # restore default disposition: a second signal kills for real
+        # instead of being swallowed while the dump runs
+        signal.signal(signum, signal.SIG_DFL)
+        logger.warning(
+            f"received signal {signum}; will dump + exit at the next "
+            f"step boundary (send again to kill immediately)"
+        )
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set()
+
+
+def preempt_exit(
+    recover,
+    engine,
+    step_info,
+    *,
+    rollout_engines=(),
+    dump_kwargs=None,
+) -> None:
+    """Orderly preemption retreat; does not return.
+
+    `rollout_engines` are paused (no new submissions) and their in-flight
+    generation interrupted (best-effort — the fleet may already be dying
+    with us) before the force-dump, so the dumped staleness ledger is
+    quiescent.  `dump_kwargs` are forwarded to `recover.dump` (saver,
+    dataloader, tokenizer, extra_engines, inference_engine, ...).
+    """
+    for r in rollout_engines:
+        try:
+            r.pause()
+        except Exception as e:  # noqa: BLE001 — retreat must not crash
+            logger.warning(f"pause on preemption failed: {e!r}")
+        try:
+            r.pause_generation()
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"generation interrupt on preemption failed: {e!r}")
+    path = recover.dump(engine, step_info, **(dump_kwargs or {}))
+    logger.warning(
+        f"preemption dump complete ({path}); exiting with resume code "
+        f"{RESUME_EXIT_CODE}"
+    )
+    sys.exit(RESUME_EXIT_CODE)

@@ -372,6 +372,14 @@ CLIENT_RESUBMISSIONS = TRAIN.counter(
     "Trajectories resubmitted to another server after a backend failure",
 )
 
+# Incremented once per successful RecoverHandler.load — a relaunched run
+# resuming from a recover generation (utils/recover.py).  Registered at
+# import for the same early-visibility reason as above.
+TRAIN_RECOVER = TRAIN.counter(
+    "areal_train_recover_total",
+    "Trainer restarts that resumed from a recover checkpoint generation",
+)
+
 
 # ---------------------------------------------------------------------------
 # Event log

@@ -18,7 +18,7 @@ import sys
 
 import numpy as np
 
-from areal_tpu.api.config import GRPOConfig, load_expr_config
+from areal_tpu.api.config import GRPOConfig, load_expr_config, to_dict
 from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
 from areal_tpu.engine.jax_remote import RemoteJaxEngine
 from areal_tpu.engine.ppo import JaxPPOActor
@@ -27,8 +27,13 @@ from areal_tpu.reward import gsm8k_reward_fn
 from areal_tpu.utils import logging, seeding, stats
 from areal_tpu.utils.dataloader import StatefulDataLoader
 from areal_tpu.utils.evaluator import Evaluator
-from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.recover import (
+    RecoverHandler,
+    check_if_recover,
+    config_fingerprint,
+)
 from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.shutdown import PreemptionGuard, preempt_exit
 from areal_tpu.utils.stats_logger import StatsLogger
 from areal_tpu.workflow.rlvr import RLVRWorkflow
 
@@ -38,6 +43,8 @@ logger = logging.getLogger("gsm8k_grpo")
 def main(argv):
     config, _ = load_expr_config(argv, GRPOConfig)
     seeding.set_random_seed(config.seed, "trainer")
+    # SIGTERM/SIGINT -> dump + resume-code exit at the next step boundary
+    guard = PreemptionGuard().install()
 
     tokenizer = None
     if config.tokenizer_path:
@@ -188,7 +195,15 @@ def main(argv):
     checkpointer = Saver(config.checkpointer, ft_spec, for_recover=True)
     evaluator = Evaluator(config.evaluator, ft_spec)
     stats_logger = StatsLogger(config.stats_logger)
-    recover = RecoverHandler(config.recover, ft_spec)
+    recover = RecoverHandler(
+        config.recover, ft_spec, fingerprint=config_fingerprint(to_dict(config))
+    )
+    # everything a force-dump needs, shared by the periodic dump and the
+    # preemption retreat
+    dump_kwargs = dict(
+        saver=saver, evaluator=evaluator, stats_logger=stats_logger,
+        dataloader=dataloader, tokenizer=tokenizer, inference_engine=rollout,
+    )
 
     start_step = 0
     if check_if_recover(config.recover, run_id=int(os.environ.get("AREAL_RUN_ID", 0))):
@@ -267,11 +282,7 @@ def main(argv):
         with stats.record_timing("save_eval"):
             saver.save(actor, epoch, epoch_step, global_step, tokenizer=tokenizer)
             if checkpointer.freq.check(epoch, global_step):
-                recover.dump(
-                    actor, step_info, saver=saver, evaluator=evaluator,
-                    stats_logger=stats_logger, dataloader=dataloader,
-                    tokenizer=tokenizer,
-                )
+                recover.dump(actor, step_info, **dump_kwargs)
 
         with stats.record_timing("eval"):
             # evaluate the freshly pushed weights on the held-out split
@@ -306,6 +317,15 @@ def main(argv):
             f"(global {global_step + 1}/{total_steps}) done. "
             f"reward={reward_mean:.3f}"
         )
+
+        if guard.requested:
+            # preemption announced: the step just completed is the dump
+            # point, so the relaunch loses zero steps
+            preempt_exit(
+                recover, actor, step_info,
+                rollout_engines=(rollout, eval_rollout),
+                dump_kwargs=dump_kwargs,
+            )
 
     rollout.destroy()
     eval_rollout.destroy()

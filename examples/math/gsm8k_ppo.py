@@ -28,7 +28,7 @@ import sys
 
 import numpy as np
 
-from areal_tpu.api.config import PPOConfig, load_expr_config
+from areal_tpu.api.config import PPOConfig, load_expr_config, to_dict
 from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
 from areal_tpu.engine.jax_remote import RemoteJaxEngine
 from areal_tpu.engine.ppo import JaxPPOActor, JaxPPOCritic
@@ -36,8 +36,13 @@ from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.reward import gsm8k_reward_fn
 from areal_tpu.utils import logging, seeding, stats
 from areal_tpu.utils.dataloader import StatefulDataLoader
-from areal_tpu.utils.recover import RecoverHandler, check_if_recover
+from areal_tpu.utils.recover import (
+    RecoverHandler,
+    check_if_recover,
+    config_fingerprint,
+)
 from areal_tpu.utils.saver import Saver
+from areal_tpu.utils.shutdown import PreemptionGuard, preempt_exit
 from areal_tpu.utils.stats_logger import StatsLogger
 from areal_tpu.workflow.rlvr import RLVRWorkflow
 
@@ -47,6 +52,7 @@ logger = logging.getLogger("gsm8k_ppo")
 def main(argv):
     config, _ = load_expr_config(argv, PPOConfig)
     seeding.set_random_seed(config.seed, "trainer")
+    guard = PreemptionGuard().install()
 
     tokenizer = None
     if config.tokenizer_path:
@@ -118,7 +124,14 @@ def main(argv):
     saver = Saver(config.saver, ft_spec)
     checkpointer = Saver(config.checkpointer, ft_spec, for_recover=True)
     stats_logger = StatsLogger(config.stats_logger)
-    recover = RecoverHandler(config.recover, ft_spec)
+    recover = RecoverHandler(
+        config.recover, ft_spec, fingerprint=config_fingerprint(to_dict(config))
+    )
+    dump_kwargs = dict(
+        saver=saver, stats_logger=stats_logger, dataloader=dataloader,
+        tokenizer=tokenizer, extra_engines={"critic": critic},
+        inference_engine=rollout,
+    )
 
     start_step = 0
     if check_if_recover(config.recover, run_id=int(os.environ.get("AREAL_RUN_ID", 0))):
@@ -218,12 +231,7 @@ def main(argv):
                 saver.save(critic, epoch, epoch_step, global_step,
                            name="critic", force=True, tokenizer=tokenizer)
             if checkpointer.freq.check(epoch, global_step):
-                recover.dump(
-                    actor, step_info, saver=saver,
-                    stats_logger=stats_logger, dataloader=dataloader,
-                    tokenizer=tokenizer,
-                    extra_engines={"critic": critic},
-                )
+                recover.dump(actor, step_info, **dump_kwargs)
 
         actor.flush_stats()
         reward_mean = float(np.mean(batch["rewards"])) if "rewards" in batch else 0.0
@@ -238,6 +246,15 @@ def main(argv):
             f"(global {global_step + 1}/{total_steps}) done. "
             f"reward={reward_mean:.3f}"
         )
+
+        if guard.requested:
+            # preemption announced: the just-completed step is the dump
+            # point — the relaunch loses zero steps
+            preempt_exit(
+                recover, actor, step_info,
+                rollout_engines=(rollout,),
+                dump_kwargs=dump_kwargs,
+            )
 
     rollout.destroy()
     stats_logger.close()

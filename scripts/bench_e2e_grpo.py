@@ -376,6 +376,117 @@ def run_mode_remote(mode: str, actor, client, server_engine, meta, workflow,
                          label="remote ", recorder=recorder)
 
 
+def run_recoverable(args, actor, client, workflow, dataset):
+    """Crash-safe loop (ISSUE 15): per-step atomic recover generations +
+    disk weight publishes, resumable across SIGKILL via AREAL_RUN_ID —
+    the launchers' relaunch contract, runnable standalone in CI.  Each
+    completed step appends one line to ``{recover_dir}/steps.jsonl``
+    ({run_id, global_step, version, ledger, ledger_ok}) and rewrites
+    ``events_run{run_id}.jsonl``, so a kill at ANY instant leaves enough
+    evidence to gate step continuity and ledger invariants on."""
+    from areal_tpu.api.config import RecoverConfig
+    from areal_tpu.api.io_struct import StepInfo, WeightUpdateMeta
+    from areal_tpu.utils import telemetry
+    from areal_tpu.utils.dataloader import StatefulDataLoader
+    from areal_tpu.utils.faults import (
+        arm_fault_point,
+        fault_point,
+        kill_trainer_at_step,
+    )
+    from areal_tpu.utils.recover import (
+        RecoverHandler,
+        check_if_recover,
+        config_fingerprint,
+    )
+    from areal_tpu.utils.shutdown import PreemptionGuard, preempt_exit
+
+    # SIGTERM/SIGINT -> force-dump + RESUME_EXIT_CODE at the step boundary
+    guard = PreemptionGuard().install()
+    run_id = int(os.environ.get("AREAL_RUN_ID", 0))
+    os.makedirs(args.recover_dir, exist_ok=True)
+    meta = WeightUpdateMeta.from_disk("e2e-bench", "recover", args.recover_dir)
+    rcfg = RecoverConfig(mode="fault", experiment_name="e2e-bench",
+                         trial_name="recover", fileroot=args.recover_dir)
+    recover = RecoverHandler(rcfg, fingerprint=config_fingerprint({
+        "model": args.model, "batch_size": args.batch_size,
+        "group_size": args.group_size, "workflow": args.workflow,
+        "max_new_tokens": args.max_new_tokens,
+    }))
+    dataloader = StatefulDataLoader(dataset, batch_size=args.batch_size,
+                                    seed=0)
+    start_step = 0
+    if check_if_recover(rcfg, run_id=run_id):
+        info = recover.load(actor, dataloader=dataloader,
+                            inference_engine=client,
+                            weight_update_meta=meta)
+        if info is not None:
+            start_step = info.recover_start.global_step
+            print(f"recovered: resuming run {run_id} at step {start_step}",
+                  file=sys.stderr, flush=True)
+    if args.kill_at_step >= start_step:
+        kill_trainer_at_step(args.kill_at_step, start_step)
+    if args.kill_mid_dump_at_step >= start_step:
+        arm_fault_point("recover_mid_dump",
+                        at_hit=args.kill_mid_dump_at_step - start_step + 1)
+
+    steps_log = os.path.join(args.recover_dir, "steps.jsonl")
+    events_path = os.path.join(args.recover_dir,
+                               f"events_run{run_id}.jsonl")
+    for global_step in range(start_step, args.steps):
+        batch = client.prepare_batch(dataloader, workflow=workflow)
+        _train_consume(actor, batch)
+        version = global_step + 1
+        actor.set_version(version)
+        actor.update_weights(meta)  # disk: self-stages snapshot v{version}
+        client.update_weights(meta)
+        client.set_version(version)
+        step_info = StepInfo(epoch=0, epoch_step=global_step,
+                             global_step=global_step,
+                             steps_per_epoch=args.steps)
+        recover.dump(actor, step_info, dataloader=dataloader,
+                     inference_engine=client)
+        stat = client.executor.staleness_manager.get_stats()
+        line = {
+            "run_id": run_id,
+            "global_step": global_step,
+            "version": version,
+            "ledger": {
+                "submitted": int(stat.submitted),
+                "accepted": int(stat.accepted),
+                "rejected": int(stat.rejected),
+                "running": int(stat.running),
+            },
+            "ledger_ok": (
+                stat.submitted == stat.accepted + stat.rejected + stat.running
+                and stat.running >= 0
+            ),
+        }
+        with open(steps_log, "a") as f:
+            f.write(json.dumps(line) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        if telemetry.is_enabled():
+            # rewrite the full ring each step: intact at whatever step the
+            # kill lands
+            telemetry.EVENTS.dump_jsonl(events_path)
+        print(f"recover run{run_id} step {global_step} done "
+              f"(version {version})", file=sys.stderr, flush=True)
+        if guard.requested:
+            # the step just dumped is the resume point: zero steps lost
+            preempt_exit(recover, actor, step_info,
+                         rollout_engines=(client,),
+                         dump_kwargs={"dataloader": dataloader,
+                                      "inference_engine": client})
+        fault_point("train_step")
+    return {
+        "run_id": run_id,
+        "start_step": start_step,
+        "steps_completed": args.steps - start_step,
+        "steps_jsonl": steps_log,
+        "events_jsonl": events_path,
+    }
+
+
 def _train_consume(actor, batch):
     batch["prox_logp"] = actor.compute_logp(batch)
     actor.compute_advantages(batch)
@@ -530,6 +641,21 @@ def main():
                         "deterministically from --chaos-seed; reports "
                         "goodput + trajectory-loss fraction under fire. "
                         "Requires --transport remote and async-only --modes")
+    p.add_argument("--recover-dir", default="",
+                   help="run the crash-safe recoverable loop (ISSUE 15) "
+                        "instead of the timed A/B: per-step atomic recover "
+                        "generations + disk weight publishes under this "
+                        "dir, resumable across SIGKILL via AREAL_RUN_ID. "
+                        "Requires --transport remote and async-only --modes")
+    p.add_argument("--kill-at-step", type=int, default=-1,
+                   help="with --recover-dir: SIGKILL self (no flush) at the "
+                        "END of this global step — the trainer-kill chaos "
+                        "fault (utils/faults.py kill_trainer_at_step)")
+    p.add_argument("--kill-mid-dump-at-step", type=int, default=-1,
+                   help="with --recover-dir: SIGKILL self INSIDE this "
+                        "step's recover dump, between the staging fsync "
+                        "and the atomic rename (fault point "
+                        "recover_mid_dump) — the torn-checkpoint case")
     p.add_argument("--chaos-seed", type=int, default=0,
                    help="one integer reproduces the exact injected-failure "
                         "sequence (FaultPlan.generate)")
@@ -563,6 +689,20 @@ def main():
             p.error("--chaos runs async modes only: a sync rollout_batch "
                     "waits for its exact batch, so one lost trajectory "
                     "hangs the step; prepare_batch keeps consuming")
+    if args.recover_dir:
+        if args.transport != "remote":
+            p.error("--recover-dir requires --transport remote (the fleet "
+                    "slice: gen server rejoin + pinned disk reload is the "
+                    "machinery under test)")
+        if any(m != "async" for m in args.modes.split(",")):
+            p.error("--recover-dir runs async modes only (the recover "
+                    "harness snapshots the executor's staleness ledger)")
+        if args.chaos:
+            p.error("--recover-dir and --chaos are separate harnesses; "
+                    "run them in separate invocations")
+    elif args.kill_at_step >= 0 or args.kill_mid_dump_at_step >= 0:
+        p.error("--kill-at-step/--kill-mid-dump-at-step require "
+                "--recover-dir")
     if args.workflow == "multi_turn" and args.len_jitter > 0:
         # MultiTurnWorkflow generates with its fixed gconfig budget; per-item
         # budgets would be ignored and the result JSON would claim a
@@ -588,6 +728,10 @@ def main():
         _, train_metrics_port = telemetry.start_metrics_server(telemetry.TRAIN)
         print(f"trainer /metrics on :{train_metrics_port}",
               file=sys.stderr, flush=True)
+    elif args.recover_dir:
+        # the recover harness's step-continuity gate consumes the stitched
+        # lifecycle log, so events must flow even without --telemetry-dir
+        telemetry.set_enabled(True)
 
     from areal_tpu.api.config import GenerationHyperparameters
     from areal_tpu.api.reward import prewarm_reward_pool
@@ -758,20 +902,25 @@ def main():
             prof_ctx = profile_trace(args.xla_profile_dir)
             result["xla_profile_dir"] = args.xla_profile_dir
         with prof_ctx:
-            for mode in args.modes.split(","):
-                if args.transport == "remote":
-                    result[mode] = run_mode_remote(
-                        mode, actor, client, server_engine, meta, workflow,
-                        dataset, args.batch_size, args.steps,
-                        warmup=args.warmup, recorder=recorder,
-                    )
-                else:
-                    result[mode] = run_mode(
-                        mode, actor, serving, workflow, dataset,
-                        args.batch_size, args.steps, warmup=args.warmup,
-                        interrupt_publish=interrupt_publish,
-                        recorder=recorder,
-                    )
+            if args.recover_dir:
+                result["recover"] = run_recoverable(
+                    args, actor, client, workflow, dataset
+                )
+            else:
+                for mode in args.modes.split(","):
+                    if args.transport == "remote":
+                        result[mode] = run_mode_remote(
+                            mode, actor, client, server_engine, meta,
+                            workflow, dataset, args.batch_size, args.steps,
+                            warmup=args.warmup, recorder=recorder,
+                        )
+                    else:
+                        result[mode] = run_mode(
+                            mode, actor, serving, workflow, dataset,
+                            args.batch_size, args.steps, warmup=args.warmup,
+                            interrupt_publish=interrupt_publish,
+                            recorder=recorder,
+                        )
         if "sync" in result and "async" in result:
             result["async_over_sync_trajs_per_sec"] = round(
                 result["async"]["trajs_per_sec_per_chip"]

@@ -49,6 +49,10 @@ class FakeGenServer:
         self.delay_s = 0.0  # holds /generate in flight (load-balancing tests)
         self.requests: List[dict] = []
         self.weight_updates: List[dict] = []
+        # interleaved ("generate"|"update_weights", body) history — recovery
+        # tests assert the pinned weight reload lands BEFORE any re-admitted
+        # generate, which the two per-endpoint lists above cannot order
+        self.log: List[tuple] = []
         self.port: Optional[int] = port or None
         self._requested_port = port
         self._runner = None
@@ -70,6 +74,7 @@ class FakeGenServer:
             return faulted
         body = await request.json()
         self.requests.append(body)
+        self.log.append(("generate", body))
         if self.delay_s:
             await asyncio.sleep(self.delay_s)
         prompt = body["input_ids"]
@@ -129,6 +134,7 @@ class FakeGenServer:
             return faulted
         body = await request.json()
         self.weight_updates.append(body)
+        self.log.append(("update_weights", body))
         # a publish that names its version is authoritative (the router's
         # rejoin force-reload stamps the fleet version); legacy publishes
         # without one just advance

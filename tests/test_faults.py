@@ -158,3 +158,84 @@ def test_kill_process_sigkills_and_reaps():
     rc = kill_process(proc, timeout=10)
     assert rc == -9
     assert proc.poll() == -9  # reaped, not a zombie
+
+
+# ---------------------------------------------------------------------------
+# fault points (ISSUE 15: trainer-kill chaos hooks)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_point_raise_action_counts_hits():
+    from areal_tpu.utils.faults import (
+        InjectedFault,
+        arm_fault_point,
+        fault_point,
+        reset_fault_points,
+    )
+
+    try:
+        arm_fault_point("train_step", action="raise", at_hit=3)
+        fault_point("train_step")  # hit 1
+        fault_point("train_step")  # hit 2
+        with pytest.raises(InjectedFault):
+            fault_point("train_step")  # hit 3 fires
+        # a fired point is spent: later hits pass through
+        fault_point("train_step")
+        # unarmed names are free
+        fault_point("never_armed")
+    finally:
+        reset_fault_points()
+
+
+def test_kill_trainer_at_step_maps_to_relative_hit():
+    from areal_tpu.utils.faults import (
+        _FAULT_POINTS,
+        kill_trainer_at_step,
+        reset_fault_points,
+    )
+
+    try:
+        # resumed run: start_step=2, kill at global step 4 -> the 3rd
+        # per-step hit of this process
+        kill_trainer_at_step(4, start_step=2)
+        assert _FAULT_POINTS["train_step"]["at_hit"] == 3
+        assert _FAULT_POINTS["train_step"]["action"] == "kill"
+    finally:
+        reset_fault_points()
+
+
+def test_fault_points_parse_env(monkeypatch):
+    from areal_tpu.utils.faults import (
+        _FAULT_POINTS,
+        InjectedFault,
+        fault_point,
+        reset_fault_points,
+    )
+
+    try:
+        reset_fault_points()
+        monkeypatch.setenv(
+            "AREAL_FAULT_POINTS",
+            "recover_mid_dump@2:raise, train_step:raise",
+        )
+        fault_point("recover_mid_dump")  # hit 1 of 2: passes
+        assert _FAULT_POINTS["recover_mid_dump"]["at_hit"] == 2
+        assert _FAULT_POINTS["train_step"]["at_hit"] == 1
+        with pytest.raises(InjectedFault):
+            fault_point("recover_mid_dump")
+        with pytest.raises(InjectedFault):
+            fault_point("train_step")
+    finally:
+        reset_fault_points()
+
+
+def test_arm_fault_point_validates():
+    from areal_tpu.utils.faults import arm_fault_point, reset_fault_points
+
+    try:
+        with pytest.raises(ValueError):
+            arm_fault_point("x", action="explode")
+        with pytest.raises(ValueError):
+            arm_fault_point("x", at_hit=0)
+    finally:
+        reset_fault_points()

@@ -1,0 +1,187 @@
+"""Trainer-kill recovery e2e (ISSUE 15 acceptance): SIGKILL a real async
+training loop mid-run, relaunch it the way the launchers do (AREAL_RUN_ID
+incremented), and prove the resume contract end to end:
+
+- step continuity: the union of steps.jsonl across runs is strictly
+  increasing — no step trained twice, at most one step lost;
+- the staleness ledger invariant holds on every logged step, including the
+  first post-recovery one (in-flight-at-crash trajectories settled);
+- the surviving gen server's FIRST post-crash interaction is the pinned
+  weight reload at the RECOVERED version — before any re-admitted generate;
+- the stitched lifecycle JSONL (run0 + run1) passes obs/trace.py
+  completeness and carries exactly one run_restart boundary event;
+- kill-mid-dump (SIGKILL between staging fsync and the atomic rename)
+  leaves only a .tmp-* dir: the relaunch resumes from the previous intact
+  generation — the at-most-one-step-lost case.
+
+The trainer runs in a subprocess (tests/mp/recover_trainer.py) so the kill
+is a REAL SIGKILL; the FakeGenServer lives in THIS process and therefore
+survives the trainer's death, exactly like a disaggregated rollout fleet.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from areal_tpu.obs.trace import analyze
+
+from tests.fake_server import FakeGenServer
+
+_HARNESS = os.path.join(os.path.dirname(__file__), "mp", "recover_trainer.py")
+
+
+class _Run:
+    def __init__(self, returncode, log_path):
+        self.returncode = returncode
+        self.log_path = log_path
+
+    @property
+    def output(self):
+        with open(self.log_path) as f:
+            return f.read()
+
+
+def _launch(tmp_path, addr, run_id, steps, extra_env=None, timeout=240):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "AREAL_FAKE_SERVER_ADDR": addr,
+        "AREAL_RUN_ID": str(run_id),
+        "RECOVER_FILEROOT": str(tmp_path),
+        "RECOVER_STEPS": str(steps),
+        "RECOVER_STEPS_LOG": str(tmp_path / "steps.jsonl"),
+        "RECOVER_EVENTS_PATH": str(tmp_path / f"events_run{run_id}.jsonl"),
+    }
+    env.pop("AREAL_FAULT_POINTS", None)
+    env.update(extra_env or {})
+    # log to a FILE, not pipes: the trainer's reward-pool workers inherit
+    # its stdio and outlive the SIGKILL, so communicate() on a pipe would
+    # block on the orphans long after the trainer itself is dead
+    log_path = tmp_path / f"trainer_run{run_id}.log"
+    with open(log_path, "a") as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, _HARNESS],
+            env=env, stdout=log_f, stderr=subprocess.STDOUT,
+        )
+        rc = proc.wait(timeout=timeout)
+    return _Run(rc, log_path)
+
+
+def _read_steps(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _stitched_events(tmp_path, run_ids):
+    events = []
+    for rid in run_ids:
+        with open(tmp_path / f"events_run{rid}.jsonl") as f:
+            events.extend(json.loads(line) for line in f if line.strip())
+    return events
+
+
+def test_sigkill_trainer_then_relaunch_resumes(tmp_path):
+    server = FakeGenServer(completion=list(range(100, 106)), chunk_size=2)
+    addr = server.start()
+    try:
+        # run 0: dies with SIGKILL at the end of step 1 (steps 0-1 trained)
+        p0 = _launch(tmp_path, addr, run_id=0, steps=4,
+                     extra_env={"RECOVER_KILL_AT_STEP": "1"})
+        assert p0.returncode == -signal.SIGKILL, (
+            f"rc={p0.returncode}\n{p0.output}"
+        )
+        n_before_relaunch = len(server.log)
+        assert n_before_relaunch > 0
+
+        # relaunch the way launcher/local.py does: AREAL_RUN_ID += 1
+        p1 = _launch(tmp_path, addr, run_id=1, steps=4)
+        assert p1.returncode == 0, (
+            f"rc={p1.returncode}\n{p1.output}"
+        )
+
+        # 1. step continuity: no step trained twice, none skipped
+        lines = _read_steps(tmp_path)
+        assert [ln["global_step"] for ln in lines] == [0, 1, 2, 3]
+        assert [ln["run_id"] for ln in lines] == [0, 0, 1, 1]
+        # the resumed run continues the version sequence, not restarts it
+        assert [ln["version"] for ln in lines] == [1, 2, 3, 4]
+
+        # 2. ledger invariant on every step, including the first recovered
+        assert all(ln["ledger_ok"] for ln in lines), lines
+        post = lines[2]["ledger"]
+        assert post["submitted"] == (
+            post["accepted"] + post["rejected"] + post["running"]
+        )
+
+        # 3. the first post-crash server interaction is the PINNED weight
+        # reload at the recovered version (last dumped step 1 -> version 2),
+        # before any re-admitted generate
+        post_crash = server.log[n_before_relaunch:]
+        assert post_crash, "relaunch never reached the gen server"
+        kind, body = post_crash[0]
+        assert kind == "update_weights", post_crash[:3]
+        assert body["version"] == 2
+        assert any(k == "generate" for k, _ in post_crash[1:])
+        # the run's final publish left the fleet at the final version
+        assert server.version == 4
+
+        # 4. stitched lifecycle log: complete, with ONE restart boundary
+        events = _stitched_events(tmp_path, (0, 1))
+        report = analyze(events)
+        assert report.completeness.complete, report.completeness
+        assert len(report.restarts) == 1
+        boundary = report.restarts[0]
+        assert boundary["run_id"] == 1
+        assert boundary["recovered_step"] == 1
+        assert boundary["resume_step"] == 2
+        assert boundary["weight_version"] == 2
+    finally:
+        server.stop()
+
+
+def test_sigkill_mid_dump_resumes_from_previous_generation(tmp_path):
+    """The torn-dump case, with a REAL SIGKILL between the staging fsync
+    and the atomic rename (fault point `recover_mid_dump`, 2nd hit = the
+    step-1 dump).  gen-00000000 stays intact; the relaunch replays step 1 —
+    at most one step lost, never a torn checkpoint consumed."""
+    server = FakeGenServer(completion=list(range(100, 106)), chunk_size=2)
+    addr = server.start()
+    try:
+        p0 = _launch(tmp_path, addr, run_id=0, steps=3,
+                     extra_env={"AREAL_FAULT_POINTS": "recover_mid_dump@2:kill"})
+        assert p0.returncode == -signal.SIGKILL, (
+            f"rc={p0.returncode}\n{p0.output}"
+        )
+        recover_root = tmp_path / "recover-e2e" / "t" / "recover"
+        assert (recover_root / "gen-00000000").is_dir()
+        assert (recover_root / ".tmp-00000001").is_dir()  # the torn dump
+        assert not (recover_root / "gen-00000001").exists()
+        # only step 0 ever hit steps.jsonl (the log line follows the dump)
+        assert [ln["global_step"] for ln in _read_steps(tmp_path)] == [0]
+
+        p1 = _launch(tmp_path, addr, run_id=1, steps=3)
+        assert p1.returncode == 0, (
+            f"rc={p1.returncode}\n{p1.output}"
+        )
+        lines = _read_steps(tmp_path)
+        # step 1 is REPLAYED from gen-00000000 (it never completed a dump);
+        # still strictly increasing — nothing trained twice
+        assert [ln["global_step"] for ln in lines] == [0, 1, 2]
+        assert [ln["run_id"] for ln in lines] == [0, 1, 1]
+        assert all(ln["ledger_ok"] for ln in lines)
+        # recovered from step 0 -> pinned reload at version 1
+        events = _stitched_events(tmp_path, (0, 1))
+        report = analyze(events)
+        assert report.completeness.complete, report.completeness
+        assert len(report.restarts) == 1
+        assert report.restarts[0]["recovered_step"] == 0
+        assert report.restarts[0]["weight_version"] == 1
+        # the torn staging dir was swept by the first successful dump
+        assert not (recover_root / ".tmp-00000001").exists()
+    finally:
+        server.stop()

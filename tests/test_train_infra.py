@@ -150,13 +150,190 @@ def test_check_if_recover_modes(tmp_path):
     cfg = RecoverConfig(mode="disabled", experiment_name="e", trial_name="t",
                         fileroot=str(tmp_path))
     assert not check_if_recover(cfg)
+
+    # `resume` on a MISSING checkpoint is an error, not a silent fresh
+    # start — the user explicitly asked to continue a run
+    cfg.mode = "resume"
+    with pytest.raises(FileNotFoundError):
+        check_if_recover(cfg)
+    cfg.mode = "auto"
+    assert not check_if_recover(cfg)
+
+    # fabricate a completed generation: only gen-*/manifest.json counts
+    root = os.path.join(tmp_path, "e", "t", "recover")
+    gen = os.path.join(root, "gen-00000002")
+    os.makedirs(gen)
+    with open(os.path.join(gen, "manifest.json"), "w") as f:
+        f.write("{}")
     cfg.mode = "fault"
-    os.makedirs(os.path.join(tmp_path, "e", "t", "recover"), exist_ok=True)
-    open(os.path.join(tmp_path, "e", "t", "recover", "recover_info.pkl"), "wb").close()
     assert not check_if_recover(cfg, run_id=0)  # fresh submit
     assert check_if_recover(cfg, run_id=1)  # relaunch
     cfg.mode = "resume"
     assert check_if_recover(cfg, run_id=0)
+    cfg.mode = "auto"
+    assert check_if_recover(cfg)
+
+    # a staging dir alone (crash mid-dump before the rename) is invisible
+    import shutil
+    shutil.rmtree(gen)
+    os.makedirs(os.path.join(root, ".tmp-00000003"))
+    assert not check_if_recover(cfg)
+
+
+def test_dump_is_atomic_and_torn_dump_falls_back(tmp_path):
+    """ISSUE 15 tentpole (a): a crash between staging and rename leaves
+    only a .tmp-* dir; load() keeps serving the previous generation.  The
+    in-process variant arms the `recover_mid_dump` fault point with
+    action='raise' (the subprocess SIGKILL variant lives in
+    tests/test_recover_e2e.py)."""
+    from areal_tpu.utils.faults import (
+        InjectedFault,
+        arm_fault_point,
+        reset_fault_points,
+    )
+
+    rng = np.random.default_rng(2)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    eng = _engine()
+    eng.train_lm(batch)
+    cfg = RecoverConfig(mode="auto", experiment_name="torn", trial_name="t",
+                        fileroot=str(tmp_path))
+    handler = RecoverHandler(cfg)
+    step1 = StepInfo(epoch=0, epoch_step=1, global_step=1, steps_per_epoch=8)
+    handler.dump(eng, step1)
+    ref = eng.forward(batch)
+
+    eng.train_lm(batch)
+    step2 = StepInfo(epoch=0, epoch_step=2, global_step=2, steps_per_epoch=8)
+    try:
+        arm_fault_point("recover_mid_dump", action="raise")
+        with pytest.raises(InjectedFault):
+            handler.dump(eng, step2)
+    finally:
+        reset_fault_points()
+    # the torn attempt left a staging dir, never a gen-00000002
+    root = handler.recover_root()
+    assert os.path.isdir(os.path.join(root, ".tmp-00000002"))
+    assert not os.path.isdir(os.path.join(root, "gen-00000002"))
+
+    eng2 = _engine()
+    info = handler.load(eng2)
+    assert info is not None
+    assert info.last_step_info.global_step == 1  # the intact generation
+    assert eng2.get_version() == 2
+    np.testing.assert_allclose(eng2.forward(batch), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_tampered_generation_rejected_and_falls_back(tmp_path):
+    """Manifest digest validation: corrupting any file of the newest
+    generation makes load() skip it and restore the previous one; a
+    truncated manifest is an unreadable generation, same outcome."""
+    rng = np.random.default_rng(3)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    eng = _engine()
+    eng.train_lm(batch)
+    cfg = RecoverConfig(mode="auto", experiment_name="tamper", trial_name="t",
+                        fileroot=str(tmp_path))
+    handler = RecoverHandler(cfg)
+    handler.dump(eng, StepInfo(epoch=0, epoch_step=1, global_step=1,
+                               steps_per_epoch=8))
+    ref = eng.forward(batch)
+    eng.train_lm(batch)
+    handler.dump(eng, StepInfo(epoch=0, epoch_step=2, global_step=2,
+                               steps_per_epoch=8))
+
+    # flip bytes in the newest generation's model weights
+    gen2 = handler.generations()[-1]
+    assert gen2.endswith("gen-00000002")
+    victim = os.path.join(gen2, "recover_state.pkl")
+    with open(victim, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+    info = handler.load(_engine())
+    assert info is not None
+    assert info.last_step_info.global_step == 1
+
+    # size-preserving tamper of a checkpoint file is caught by the digest
+    eng3 = _engine()
+    info = handler.load(eng3)
+    np.testing.assert_allclose(eng3.forward(batch), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_config_fingerprint_mismatch_refused(tmp_path):
+    """A checkpoint written under a different config fingerprint must be
+    refused (raise), never silently resumed or fallen back from."""
+    from areal_tpu.utils.recover import RecoverConfigMismatch, config_fingerprint
+
+    rng = np.random.default_rng(4)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    eng = _engine()
+    eng.train_lm(batch)
+    cfg = RecoverConfig(mode="auto", experiment_name="fp", trial_name="t",
+                        fileroot=str(tmp_path))
+    fp_a = config_fingerprint({"lr": 1e-2, "batch": 4})
+    fp_b = config_fingerprint({"lr": 5e-3, "batch": 4})
+    assert fp_a != fp_b
+    handler = RecoverHandler(cfg, fingerprint=fp_a)
+    handler.dump(eng, StepInfo(epoch=0, epoch_step=1, global_step=1,
+                               steps_per_epoch=8))
+    # same fingerprint loads fine
+    assert handler.load(_engine()) is not None
+    # a different one is refused
+    other = RecoverHandler(cfg, fingerprint=fp_b)
+    with pytest.raises(RecoverConfigMismatch):
+        other.load(_engine())
+
+
+def test_recover_sidecar_manifest_and_prune(tmp_path):
+    """The recover_info.json sidecar carries the full human-readable
+    manifest (step, version, run_id, timestamps, generation paths), and
+    generations beyond the retention window are pruned."""
+    rng = np.random.default_rng(5)
+    batch = {
+        "input_ids": rng.integers(0, 64, (4, 10)).astype(np.int32),
+        "attention_mask": np.ones((4, 10), bool),
+        "loss_mask": np.ones((4, 10), np.float32),
+    }
+    eng = _engine()
+    cfg = RecoverConfig(mode="auto", experiment_name="side", trial_name="t",
+                        fileroot=str(tmp_path))
+    handler = RecoverHandler(cfg)
+    for step in (1, 2, 3):
+        eng.train_lm(batch)
+        eng.set_version(step + 1)
+        handler.dump(eng, StepInfo(epoch=0, epoch_step=step, global_step=step,
+                                   steps_per_epoch=8))
+    gens = handler.generations()
+    assert [os.path.basename(g) for g in gens] == \
+        ["gen-00000002", "gen-00000003"]  # gen-00000001 pruned
+    with open(os.path.join(handler.recover_root(), "recover_info.json")) as f:
+        side = json.load(f)
+    assert side["last_step_info"]["global_step"] == 3
+    assert side["weight_version"] == 4
+    assert side["run_id"] == int(os.environ.get("AREAL_RUN_ID", 0))
+    assert side["latest"].endswith("gen-00000003")
+    assert side["updated_ts"] > 0
+    assert [os.path.basename(g) for g in side["generations"]] == \
+        ["gen-00000002", "gen-00000003"]
+    # the per-generation manifest pins per-file digests + async state slots
+    with open(os.path.join(gens[-1], "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["schema"] == "areal-recover/v1"
+    assert manifest["files"]
+    assert all({"size", "blake2b"} <= set(v) for v in manifest["files"].values())
+    assert set(manifest["async_state"]) == \
+        {"rollout_stat", "seed", "fleet_weight_version"}
 
 
 def test_jsonl_dataset(tmp_path):
