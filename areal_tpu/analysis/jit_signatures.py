@@ -98,8 +98,10 @@ def compute_budgets(config: Dict[str, int]) -> Dict[str, int]:
         "prefill": rows * ladder * 2,
         # + static (copy_block in ladder+{0}, key_window in ladder)
         "suffix_prefill": rows * ladder * (ladder + 1) * 2,
-        # migration/fan-out copy: pow2 rows x bucketed block
-        "kv_copy": rows * ladder,
+        # host spill: one program per block bucket (row is traced)
+        "host_gather": ladder,
+        # host swap-in: shape-keyed on the same bucketed block
+        "host_scatter": ladder,
         # speculative verify (ISSUE 12): per tier x key bucket x nonzero
         # draft-length rung (D=0 reuses the decode program, so only the
         # nonzero rungs of the spec ladder mint verify signatures);
@@ -151,7 +153,8 @@ def render_budget_doc(reference_configs: Dict[str, Dict[str, int]]) -> Dict:
             "decode": "decode_tiers * ladder(prompt_bucket, max_seq_len)",
             "prefill": "rows(n_slots) * ladder * 2",
             "suffix_prefill": "rows(n_slots) * ladder * (ladder + 1) * 2",
-            "kv_copy": "rows(n_slots) * ladder",
+            "host_gather": "ladder  (traced row; one program per block bucket)",
+            "host_scatter": "ladder  (shape-keyed on the bucketed block)",
             "verify": (
                 "decode_tiers * ladder * spec_rungs  (nonzero draft-length"
                 " rungs of the spec ladder; 0 when spec decode is off)"

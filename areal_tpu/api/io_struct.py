@@ -258,6 +258,9 @@ class HttpGenerationResult:
     output_logprobs: List[float]
     stop_reason: str
     version: int = -1
+    # prompt tokens served from the server's radix/paged prefix cache
+    # (warm-started failover resubmits report nonzero here)
+    cache_hit_tokens: int = 0
 
 
 @dataclass

@@ -343,6 +343,7 @@ class RemoteInfEngine(InferenceEngine):
         input_len = len(req.input_ids)
         stop_reason = None
         ttft = float("inf")
+        resubmitted = False  # next /generate is a failover resubmission
 
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(
@@ -422,6 +423,7 @@ class RemoteInfEngine(InferenceEngine):
                             attempt=attempt,
                         )
                     telemetry.CLIENT_RESUBMISSIONS.inc()
+                    resubmitted = True
                 finally:
                     with self._lock:
                         self._inflight[addr] = max(
@@ -431,6 +433,19 @@ class RemoteInfEngine(InferenceEngine):
                     addr = next_addr
                     continue
                 result = self.backend.parse_generation_response(raw)
+                if resubmitted:
+                    # did the retried trajectory warm-start on the new
+                    # server's radix cache instead of cold-prefilling?
+                    resubmitted = False
+                    if result.cache_hit_tokens > 0:
+                        telemetry.CLIENT_RESUBMIT_CACHE_HITS.inc()
+                        if telemetry.is_enabled():
+                            telemetry.emit(
+                                "resubmit_cache_hit",
+                                trace_id=req.trace_id, rid=req.rid,
+                                server=addr,
+                                hit_tokens=result.cache_hit_tokens,
+                            )
                 stop_reason = result.stop_reason
                 version = (
                     result.version if result.version >= 0 else self.get_version()

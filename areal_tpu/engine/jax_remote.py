@@ -69,6 +69,7 @@ class JaxBackend:
             output_logprobs=list(resp["output_logprobs"]),
             stop_reason=resp["stop_reason"],
             version=int(resp.get("version", -1)),
+            cache_hit_tokens=int(resp.get("cache_hit_tokens", 0)),
         )
 
     def build_pause_request(self) -> HttpRequest:

@@ -84,6 +84,22 @@ Design for XLA's static shapes:
   admission/free/migration dirties them).  This is the slot-grid analogue
   of vLLM's block-granular PagedAttention and Sarathi-Serve's principle
   that steady-state serving cost should track occupied context.
+- **Unified radix/paged KV pool** (ISSUE 16, gen/kv_pool.py): the prefix
+  mechanisms above used to keep separate lookup state; now ONE structure
+  fronts them all.  A page table maps logical slots to physical cache
+  rows and every compiled decode/verify program reads the cache THROUGH
+  it (models/transformer.py `rows=`), so a tier migration is an O(1)
+  host-side row remap — the old device-side migration copy is gone, and
+  the displaced retained prefix survives at the vacated logical slot
+  instead of being overwritten.  A compressed radix tree indexes every
+  resident prefix (device-retained and host-spilled alike); admission
+  matching, fan-out representatives, and failover resubmits all hit
+  through one exact-lcp walk.  An optional LRU host-DRAM overflow tier
+  (`host_offload`) spills about-to-be-overwritten prefixes via bucketed
+  device->host gathers (ops/kv_copy.py) and swaps them back on a radix
+  hit — bit-identical round trip, so token streams are invariant to
+  spill scheduling.  Lookups stay host-side and block shapes ride the
+  existing bucket ladders: steady state still mints zero XLA programs.
 """
 
 # areal-lint: hot-path
@@ -107,8 +123,9 @@ from areal_tpu.gen.spec import (
     SpecController,
     propose_draft,
 )
+from areal_tpu.gen.kv_pool import KVPool, lcp_ids
 from areal_tpu.models.model_config import TransformerConfig
-from areal_tpu.ops.kv_copy import copy_kv_prefix
+from areal_tpu.ops.kv_copy import gather_kv_prefix, scatter_kv_prefix
 from areal_tpu.models.transformer import (
     forward_decode,
     forward_prefill,
@@ -124,15 +141,6 @@ from areal_tpu.utils import logging, telemetry
 from areal_tpu.utils.datapack import round_up_to_bucket
 
 logger = logging.getLogger("gen.engine")
-
-
-def _lcp_ids(a: List[int], b: List[int]) -> int:
-    """Longest common prefix of two token lists (vectorised)."""
-    m = min(len(a), len(b))
-    if m == 0:
-        return 0
-    neq = np.asarray(a[:m], np.int64) != np.asarray(b[:m], np.int64)
-    return int(neq.argmax()) if neq.any() else m
 
 
 def plan_decode_tiers(
@@ -200,6 +208,10 @@ class GenRequest:
     output_logprobs: List[float] = field(default_factory=list)
     output_versions: List[int] = field(default_factory=list)
     stop_reason: str = ""
+    # prompt tokens inherited from the unified prefix cache at admission
+    # (retained reuse, fan-out share, or host swap-in) — surfaced on the
+    # wire so a failover resubmit can prove its radix warm start
+    cache_hit_tokens: int = 0
     on_done: Optional[Callable[["GenRequest"], None]] = None
 
     def finish(self, reason: str):
@@ -289,6 +301,9 @@ class GenEngine:
         spec_probe_every: int = 8,
         spec_accept_hi: float = 0.5,
         spec_accept_lo: float = 0.2,
+        host_offload: bool = False,
+        host_cache_mb: int = 64,
+        host_min_tokens: int = 32,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -428,6 +443,18 @@ class GenEngine:
         self._parked_free: Optional[frozenset] = None
         self._parked_until: float = 0.0
         self._slot_vlm = np.zeros(S, bool)  # VLM slots never reuse (mrope)
+        # --- unified radix/paged KV pool (ISSUE 16) --------------------
+        # page-table indirection (logical slot -> physical cache row, read
+        # by every decode/verify dispatch), a radix tree over all resident
+        # prefixes (one exact-lcp match serves retained reuse, fan-out,
+        # and failover resubmits), and the optional LRU host-DRAM
+        # overflow tier.  Prefixes shorter than host_min_tokens are not
+        # worth a device<->host round trip and just evict.
+        self.host_min_tokens = host_min_tokens
+        self.pool = KVPool(
+            n_slots,
+            host_bytes=(int(host_cache_mb) << 20) if host_offload else 0,
+        )
         # --- tiered decode (ISSUE 5) -----------------------------------
         # length-cohort tiers: contiguous slot blocks [tier_start[t],
         # tier_start[t] + tier_size[t]) with ascending ceilings
@@ -549,6 +576,19 @@ class GenEngine:
             "spec_drafted": 0,
             "spec_accepted": 0,
             "verify_calls": 0,
+            # unified prefix cache (ISSUE 16): admission outcomes through
+            # the radix/paged pool.  hits = admitted rows that inherited a
+            # resident prefix (retained reuse, fan-out siblings, host
+            # swap-ins); misses = cold/VLM admissions; evictions =
+            # resident prefixes overwritten or LRU-dropped before any hit
+            # consumed them; host_swaps = device<->host prefix transfers
+            # (spills + swap-ins).  The server mirrors all four as
+            # areal_gen_prefix_cache_*_total and derives the global
+            # hit-rate gauge from hits / (hits + misses).
+            "prefix_cache_hits": 0,
+            "prefix_cache_misses": 0,
+            "prefix_cache_evictions": 0,
+            "prefix_cache_host_swaps": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -579,15 +619,19 @@ class GenEngine:
 
         def _decode_chunk(
             params, cache, tokens, lengths, rope_pos, streams, active,
-            temp, tp, tk, decode_key, n, base, size, key_window,
+            temp, tp, tk, decode_key, rows, n, base, size, key_window,
         ):
-            """Advance ONE length-cohort tier — the `size` slots at cache
-            rows [base, base+size) — by `n` fused decode+sample steps.
-            `tokens`/`lengths`/`rope_pos` are the FULL device-resident
-            state arrays (donated; returned with the block advanced), so
-            consecutive tier dispatches chain device->device with no host
-            upload.  `key_window` statically bounds the attended span
-            (bucket ladder); `active` drops idle slots' cache writes."""
+            """Advance ONE length-cohort tier — the `size` slots at
+            logical positions [base, base+size) — by `n` fused
+            decode+sample steps.  `tokens`/`lengths`/`rope_pos` are the
+            FULL device-resident state arrays (donated; returned with the
+            block advanced), so consecutive tier dispatches chain
+            device->device with no host upload.  `key_window` statically
+            bounds the attended span (bucket ladder); `active` drops idle
+            slots' cache writes.  `rows` is the page table (traced data):
+            each logical slot reads/writes its KV through its physical
+            row, so a migration remap costs zero new programs."""
+            rows_b = jax.lax.slice_in_dim(rows, base, base + size)
             tok_b = jax.lax.slice_in_dim(tokens, base, base + size)
             len_b = jax.lax.slice_in_dim(lengths, base, base + size)
             rp_b = jax.lax.slice_in_dim(rope_pos, base, base + size)
@@ -605,7 +649,7 @@ class GenEngine:
                 logits, cache = forward_decode(
                     params, cfg, tok_b, len_b, cache,
                     rope_positions=rp_b, key_window=key_window,
-                    slot_base=base, active=act_b,
+                    slot_base=base, active=act_b, rows=rows_b,
                 )
                 # counter-based keys: (stream, cache position) — unique
                 # per generated token, independent of how the grid is
@@ -628,7 +672,7 @@ class GenEngine:
 
         def _verify_chunk(
             params, cache, tokens, lengths, rope_pos, streams, active,
-            temp, tp, tk, decode_key, drafts, draft_lens,
+            temp, tp, tk, decode_key, rows, drafts, draft_lens,
             base, size, key_window, d_max,
         ):
             """Speculative step for ONE tier: score the pending token plus
@@ -642,6 +686,7 @@ class GenEngine:
             draft positions get their freshly-written K/V zeroed before
             the dispatch returns, so no rejected write outlives it."""
             Dp1 = d_max + 1
+            rows_b = jax.lax.slice_in_dim(rows, base, base + size)
             tok_b = jax.lax.slice_in_dim(tokens, base, base + size)
             len_b = jax.lax.slice_in_dim(lengths, base, base + size)
             rp_b = jax.lax.slice_in_dim(rope_pos, base, base + size)
@@ -656,6 +701,7 @@ class GenEngine:
                 params, cfg, inputs, len_b, cache,
                 rope_positions=rp_b, key_window=key_window,
                 slot_base=base, active=act_b, n_write=n_write,
+                rows=rows_b,
             )  # [size, Dp1, V]
             # position-keyed sampling: logits[:, j] is the distribution at
             # sequence position len + j, exactly the row a plain decode
@@ -697,7 +743,7 @@ class GenEngine:
                 offs[None, :] < n_write[:, None]
             ) & act_b[:, None]
             rej_idx = jnp.where(rej, pos, M_cache)
-            slots = base + jnp.arange(size)
+            slots = rows_b  # zero the PHYSICAL rows the writes landed in
             cache = {
                 "k": cache["k"].at[:, slots[:, None], rej_idx].set(
                     0, mode="drop"
@@ -736,8 +782,9 @@ class GenEngine:
         # signature family: (tier block, chunk, K bucket) — tiers and
         # chunk are fixed per engine, K rides the pow2 prompt-bucket
         # ladder, so steady state compiles O(tiers x log(M/quantum))
-        # programs and then mints none (pinned by test)
-        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(11, 12, 13, 14),
+        # programs and then mints none (pinned by test); the page-table
+        # rows arg is traced data and adds no signatures
+        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(12, 13, 14, 15),
                                   donate_argnums=(1, 2, 3, 4))
         # verify signature family: (tier block, K bucket, D rung) — D
         # rides the small static spec ladder (D=0 reuses the decode
@@ -745,12 +792,15 @@ class GenEngine:
         # tiers x ladder x |nonzero rungs| programs at most, budgeted in
         # analysis/signature_budget.json ("verify") and pinned by the
         # jit-cache soak tests
-        self._verify_fn = jax.jit(_verify_chunk, static_argnums=(13, 14, 15, 16),
+        self._verify_fn = jax.jit(_verify_chunk, static_argnums=(14, 15, 16, 17),
                                   donate_argnums=(1, 2, 3, 4))
-        # tier migration: batched device-side cache-row copy (the group
-        # fan-out machinery reused verbatim); block is bucketed
-        self._kv_copy_fn = jax.jit(copy_kv_prefix, static_argnums=(3,),
-                                   donate_argnums=(0,))
+        # host-DRAM overflow tier (ISSUE 16): spill gathers one physical
+        # row's bucketed prefix (block static on the prompt ladder — one
+        # program per bucket); swap-in scatters it back shape-keyed (same
+        # ladder bound), with the cache donated so the restore is in-place
+        self._host_gather_fn = jax.jit(gather_kv_prefix, static_argnums=(2,))
+        self._host_scatter_fn = jax.jit(scatter_kv_prefix,
+                                        donate_argnums=(0,))
         self._init_vlm()
 
     def _init_vlm(self) -> None:
@@ -860,16 +910,19 @@ class GenEngine:
                         0 if self._slot_vlm[s] else self.lengths[s]
                     )
                     # reserve only prefixes the owner's resubmission can
-                    # actually claim: its lcp is capped below len(ids) in
-                    # _slot_lcps, so at retained_len == reuse_min_tokens
-                    # the slot would sit reserved-yet-unclaimable for the
-                    # whole TTL — the threshold must be STRICTLY greater
-                    # (ADVICE r5)
+                    # actually claim: its lcp is capped below len(ids) by
+                    # the admission match, so at retained_len ==
+                    # reuse_min_tokens the slot would sit
+                    # reserved-yet-unclaimable for the whole TTL — the
+                    # threshold must be STRICTLY greater (ADVICE r5)
                     if (
                         self.kv_reuse
                         and self.retained_len[s] > self.reuse_min_tokens
                     ):
                         self._reserved_until[s] = deadline
+                    self.pool.note_free(
+                        s, self.seq_tokens[s], int(self.retained_len[s])
+                    )
             self._state_dirty = True
             n_in_slot = len(to_finish)
             to_finish.extend(self._holdback)
@@ -975,6 +1028,9 @@ class GenEngine:
             self.retained_len[:] = 0
             self._reserved_until[:] = 0.0  # nothing left to reserve
             self.kv_version[:] = self.version  # no pre-swap KV survives
+            # the host tier is old-policy KV too: strict mode drops every
+            # resident prefix from the pool, spilled ones included
+            self.pool.clear()
         if getattr(self, "_standby", None) is not None:
             staged_v = self._standby[1]
             if staged_v is None or staged_v <= self.version:
@@ -1096,6 +1152,7 @@ class GenEngine:
         self.retained_len[:] = 0  # cache is gone; no prefix survives
         self._reserved_until[:] = 0.0
         self.kv_version[:] = self.version
+        self.pool.clear()  # radix entries and host spills die with it
         if drop_params:
             if isinstance(self.params, dict) and "vision" in self.params:
                 self.params = {"vision": self.params["vision"]}
@@ -1133,6 +1190,8 @@ class GenEngine:
                 k: jax.device_put(v, NamedSharding(self.mesh, self._cache_spec))
                 for k, v in cache.items()
             }
+            # fresh physical rows: the identity page table is correct again
+            self.pool.reset()
 
     @staticmethod
     def _resolve_ckpt_dir(path: str):
@@ -1158,19 +1217,103 @@ class GenEngine:
     # stepping
     # ------------------------------------------------------------------
 
-    def _slot_lcps(self, ids: np.ndarray, slots: np.ndarray) -> np.ndarray:
-        """Longest common prefix of `ids` against each slot's retained
-        cache, vectorised over `slots`.  Capped at len(ids) - 1 so at least
-        one suffix token runs through prefill (its last-position logits
-        seed sampling)."""
-        limit = len(ids) - 1
-        caps = np.minimum(self.retained_len[slots], limit)  # [f]
-        m = int(caps.max()) if caps.size else 0
-        if m <= 0:
-            return np.zeros(len(slots), np.int64)
-        neq = self.seq_tokens[slots][:, :m] != ids[:m]  # [f, m]
-        first = np.where(neq.any(axis=1), neq.argmax(axis=1), m)
-        return np.minimum(first, caps)
+    def _maybe_spill(self, slots: List[int]) -> None:
+        """LRU-spill retained prefixes about to be overwritten into the
+        host-DRAM overflow tier (no-op when `host_offload` is off).  The
+        gather is one bucketed program per block; the download rides the
+        admission boundary where the engine already syncs its planning
+        state.  Prefixes below `host_min_tokens` are not worth the round
+        trip and simply evict."""
+        if self.pool.host is None or self.cache is None:
+            return
+        for s in slots:
+            vlen = int(self.retained_len[s])
+            toks = self.pool.device_tokens(s)
+            if toks is None or vlen < self.host_min_tokens:
+                continue
+            if len(toks) != vlen:
+                continue  # stale index entry: never spill mismatched KV
+            block = round_up_to_bucket(
+                vlen, self.prompt_bucket, self.max_seq_len
+            )
+            kv_dev = self._host_gather_fn(
+                self.cache, jnp.asarray(self.pool.row(s), jnp.int32), block
+            )
+            # areal-lint: disable=host-sync delivery point: spill download at the admission boundary (one bucketed row gather per eviction)
+            kv = {k: np.asarray(v) for k, v in kv_dev.items()}
+            evicted = self.pool.host_put(
+                self.seq_tokens[s], vlen, int(self.kv_version[s]), block, kv
+            )
+            self.pool.drop_device(s)
+            self.stats["prefix_cache_host_swaps"] += 1
+            self.stats["prefix_cache_evictions"] += evicted
+
+    def _swap_in_host_hits(
+        self,
+        entries: List[tuple],
+        matched: set,
+        free_set: set,
+        slot_of_entry: Dict[int, tuple],
+        reuse_admitted: List[tuple],
+    ) -> None:
+        """Admission stage for the host overflow tier: requests the device
+        match left cold probe the radix over HOST-spilled prefixes; a hit
+        scatters the spilled KV back into a free row (bit-identical bytes
+        — the spill kept the raw cache dtype) and the request then rides
+        the ordinary suffix-prefill path as if the prefix had never left
+        HBM.  The landing slot's own retained prefix spills first when
+        eligible, so a swap-in never silently destroys resident state."""
+        now = time.monotonic()
+        for i, (req, is_vlm) in enumerate(entries[: self.match_window]):
+            if is_vlm or i in matched or not free_set:
+                continue
+            host_m = self.pool.match_host(req.input_ids)
+            if not host_m:
+                continue
+            limit = len(req.input_ids) - 1
+            best_hid, best_l = None, 0
+            for hid, l in host_m.items():
+                ent = self.pool.host_entry(hid)
+                if ent is None:
+                    continue
+                l = min(int(l), ent.valid_len, limit)
+                if l >= self.reuse_min_tokens and l > best_l:
+                    best_hid, best_l = hid, l
+            if best_hid is None:
+                continue
+            open_slots = [
+                s for s in free_set
+                if not self._slot_vlm[s] and self._reserved_until[s] <= now
+            ]
+            if not open_slots:
+                return  # nothing can land anywhere this pass
+            # overwrite the least valuable retained cache, spilling it
+            # onward when it is itself worth keeping
+            s = min(open_slots, key=lambda u: int(self.retained_len[u]))
+            self._maybe_spill([s])
+            ent = self.pool.host_take(best_hid)
+            if ent is None:
+                continue
+            self.cache = self._host_scatter_fn(
+                self.cache,
+                {k: jnp.asarray(v) for k, v in ent.kv.items()},
+                jnp.asarray(self.pool.row(s), jnp.int32),
+            )
+            vlen = ent.valid_len
+            with self._lock:
+                if self.pool.drop_device(s):
+                    self.stats["prefix_cache_evictions"] += 1
+                self.seq_tokens[s, :vlen] = ent.tokens
+                self.retained_len[s] = vlen
+                self.kv_version[s] = ent.version
+                self._slot_vlm[s] = False
+                self._reserved_until[s] = 0.0
+                self.pool.note_free(s, self.seq_tokens[s], vlen)
+            self.stats["prefix_cache_host_swaps"] += 1
+            matched.add(i)
+            free_set.remove(s)
+            slot_of_entry[i] = (s, best_l)
+            reuse_admitted.append((s, req, best_l, s, False))
 
     def _apply_group_hold(self, entries: List[tuple]):
         """Park members of a declared group (`group_id` + `group_n`) until
@@ -1249,7 +1392,7 @@ class GenEngine:
             run = [rest[0]]
             run_share: Optional[int] = None
             for prev, cur in zip(rest, rest[1:]):
-                l = _lcp_ids(
+                l = lcp_ids(
                     entries[prev][0].input_ids, entries[cur][0].input_ids
                 )
                 tentative = l if run_share is None else min(run_share, l)
@@ -1267,7 +1410,7 @@ class GenEngine:
         for members in raw:
             ids0 = entries[members[0]][0].input_ids
             share = min(
-                _lcp_ids(ids0, entries[i][0].input_ids)
+                lcp_ids(ids0, entries[i][0].input_ids)
                 for i in members[1:]
             )
             share = min(
@@ -1378,32 +1521,44 @@ class GenEngine:
         matched: set = set()
         slot_of_entry: Dict[int, tuple] = {}  # entry idx -> (slot, lcp)
         if self.kv_reuse:
-            # global matching: all (request, slot) lcp pairs, best first.
-            # Short-circuit when no free slot retains a reusable prefix
-            # (the common steady state) — the O(window x slots x prefix)
-            # numpy scan below is only worth paying when a match can exist
-            # (ADVICE r5); the scanned window is capped at match_window
-            # independently of the drain window.
-            cand_slots = np.asarray(
-                [
-                    s for s in free
-                    if not self._slot_vlm[s]
-                    and self.retained_len[s] >= self.reuse_min_tokens
-                ],
-                np.int64,
-            )
-            if cand_slots.size:
+            # global matching through the radix index: ONE tree walk per
+            # request returns the exact lcp against every resident prefix
+            # (identical numbers to the old per-slot seq_tokens scan — the
+            # entries mirror seq_tokens[:retained_len] by construction,
+            # re-validated against the live retained_len so a stale entry
+            # can cost a hit but never fabricate one).  All (request,
+            # slot) pairs then assign greedily, best lcp first, ties by
+            # arrival order; the scanned window stays capped at
+            # match_window independently of the drain window.
+            cand_set = {
+                s for s in free
+                if not self._slot_vlm[s]
+                and self.retained_len[s] >= self.reuse_min_tokens
+            }
+            if cand_set:
                 cands: List[tuple] = []
                 for i, (req, is_vlm) in enumerate(
                     entries[: self.match_window]
                 ):
                     if is_vlm:
                         continue
-                    ids = np.asarray(req.input_ids, np.int32)
-                    lcps = self._slot_lcps(ids, cand_slots)
-                    for j in np.nonzero(lcps >= self.reuse_min_tokens)[0]:
-                        # ties broken by arrival order (i ascending)
-                        cands.append((-int(lcps[j]), i, int(cand_slots[j])))
+                    # capped at len(ids) - 1 so at least one suffix token
+                    # runs through prefill (its logits seed sampling)
+                    limit = len(req.input_ids) - 1
+                    for s, l in self.pool.match_device(
+                        req.input_ids
+                    ).items():
+                        if s not in cand_set:
+                            continue
+                        toks = self.pool.device_tokens(s)
+                        if toks is None or len(toks) != int(
+                            self.retained_len[s]
+                        ):
+                            continue
+                        l = min(int(l), limit)
+                        if l >= self.reuse_min_tokens:
+                            # ties broken by arrival order (i ascending)
+                            cands.append((-l, i, s))
                 cands.sort()
                 for negl, i, s in cands:
                     if i in matched or s not in free_set:
@@ -1412,6 +1567,10 @@ class GenEngine:
                     free_set.remove(s)
                     slot_of_entry[i] = (s, -negl)
                     reuse_admitted.append((s, entries[i][0], -negl, s, False))
+        if self.kv_reuse and self.pool.host is not None and free_set:
+            self._swap_in_host_hits(
+                entries, matched, free_set, slot_of_entry, reuse_admitted
+            )
 
         clusters: List[dict] = (
             self._plan_clusters(entries, matched) if self.share_prefix else []
@@ -1527,6 +1686,23 @@ class GenEngine:
             ] + group_deadlines
             self._parked_free = frozenset(free)
             self._parked_until = min(expiries) if expiries else now + 0.05
+        # prefix-cache accounting: every admitted row is a hit (inherited
+        # a resident prefix) or a miss (cold/VLM prefill); retained
+        # prefixes about to be overwritten spill to the host tier BEFORE
+        # any prefill dispatch can clobber their rows
+        self.stats["prefix_cache_hits"] += (
+            len(reuse_admitted) + len(shared_admitted)
+        )
+        self.stats["prefix_cache_misses"] += (
+            len(admitted) + len(vlm_admitted)
+        )
+        overwrite = (
+            [s for s, _ in admitted]
+            + [s for s, _ in vlm_admitted]
+            + [s for s, *_ in shared_admitted]
+        )
+        if overwrite:
+            self._maybe_spill(overwrite)
         if telemetry.is_enabled():
             # emitted before the prefill dispatches so the admission event
             # always precedes the request's first decode/finish in the log
@@ -1593,7 +1769,7 @@ class GenEngine:
             n = len(req.input_ids)
             ids[i, :n] = req.input_ids
             plens[i] = n
-            slot_ids[i] = s
+            slot_ids[i] = self.pool.row(s)  # write through the page table
             temp[i] = req.temperature
             top_p[i] = req.top_p
             top_k[i] = req.top_k
@@ -1615,6 +1791,10 @@ class GenEngine:
         self.stats["prefill_tokens"] += int(plens[: len(admitted)].sum())
         with self._lock:
             for i, (s, req) in enumerate(admitted):
+                # a retained prefix that neither matched nor spilled is
+                # evicted by this overwrite
+                if self.pool.drop_device(s):
+                    self.stats["prefix_cache_evictions"] += 1
                 self.slot_req[s] = req
                 self.lengths[s] = plens[i]
                 self.rope_pos[s] = plens[i]
@@ -1672,8 +1852,8 @@ class GenEngine:
             ids[i, :n] = suffix
             starts[i] = start
             slens[i] = n
-            slot_ids[i] = s
-            copy_src[i] = kv_src
+            slot_ids[i] = self.pool.row(s)  # physical rows: page table
+            copy_src[i] = self.pool.row(kv_src)
             temp[i] = req.temperature
             top_p[i] = req.top_p
             top_k[i] = req.top_k
@@ -1721,8 +1901,14 @@ class GenEngine:
                 int(start)
             )
         with self._lock:
-            for i, (s, req, start, kv_src, _) in enumerate(batch):
+            for i, (s, req, start, kv_src, shared) in enumerate(batch):
                 n_total = len(req.input_ids)
+                # the slot's index entry retires: consumed by its own hit
+                # (retained reuse — not an eviction) or clobbered by a
+                # fan-out sibling landing on it (counted)
+                if self.pool.drop_device(s) and shared:
+                    self.stats["prefix_cache_evictions"] += 1
+                req.cache_hit_tokens = int(start)
                 self.slot_req[s] = req
                 self.lengths[s] = n_total
                 self.rope_pos[s] = n_total
@@ -1810,7 +1996,7 @@ class GenEngine:
             n = len(r_ids)
             ids[i, :n] = r_ids
             plens[i] = n
-            slot_ids[i] = s
+            slot_ids[i] = self.pool.row(s)
             temp[i] = req.temperature
             top_p[i] = req.top_p
             top_k[i] = req.top_k
@@ -1872,6 +2058,8 @@ class GenEngine:
         toks, logps = np.asarray(toks), np.asarray(logps)
         with self._lock:
             for i, (s, req) in enumerate(vlm_admitted):
+                if self.pool.drop_device(s):
+                    self.stats["prefix_cache_evictions"] += 1
                 self.slot_req[s] = req
                 self.lengths[s] = plens[i]
                 self.rope_pos[s] = rope_next[i]
@@ -1924,6 +2112,9 @@ class GenEngine:
             # prefix-reuse admission; the pending last token's K/V was never
             # written, so it is excluded
             self.retained_len[s] = 0 if self._slot_vlm[s] else self.lengths[s]
+            self.pool.note_free(
+                s, self.seq_tokens[s], int(self.retained_len[s])
+            )
             self._state_dirty = True
         if req is not None:
             req.finish(reason)
@@ -1962,15 +2153,25 @@ class GenEngine:
             self.stats["decode_attended_cols"] / ceiling if ceiling else 1.0
         )
 
+    def prefix_cache_hit_rate(self) -> float:
+        """Fraction of admissions that reused resident K/V through the
+        radix/paged pool (device hits + host swap-ins) over all
+        admissions; the /metrics gauge mirrors this."""
+        h = self.stats["prefix_cache_hits"]
+        m = self.stats["prefix_cache_misses"]
+        return h / (h + m) if (h + m) else 0.0
+
     def _plan_migrations(self, n: int) -> None:
         """Move slots about to outgrow their tier's ceiling into a roomier
-        cohort: ONE batched device-side cache-row copy (ops/kv_copy.py,
-        bucketed block + pow2-padded rows — the fan-out program family, no
-        new signature class), then the host state follows.  The source slot
-        frees with its prefix retained, so multi-turn matching still finds
-        it.  When nothing roomier is free the slot simply stays — its own
-        tier's K bucket grows to cover it (the top-tier fallback: ceilings
-        are placement hints, never correctness)."""
+        cohort.  Since decode reads the cache through the page table
+        (ISSUE 16), a migration is a pure HOST-SIDE row remap — zero
+        device copies, zero new programs: the request keeps its physical
+        row under a new logical slot, and the destination's old retained
+        prefix re-homes at the vacated slot (still radix-matchable, where
+        the old copy path destroyed it).  When nothing roomier is free the
+        slot simply stays — its own tier's K bucket grows to cover it
+        (the top-tier fallback: ceilings are placement hints, never
+        correctness)."""
         if self.n_tiers == 1:
             return
         now = time.monotonic()
@@ -2004,25 +2205,15 @@ class GenEngine:
                 moves.append((s, dst))
         if not moves:
             return
-        block = round_up_to_bucket(
-            int(max(self.lengths[s] for s, _ in moves)),
-            self.prompt_bucket,
-            self.max_seq_len,
-        )
-        d = 1 << (len(moves) - 1).bit_length()
-        src = np.full(d, self.n_slots, np.int32)  # pad: scratch self-copy
-        dst_a = np.full(d, self.n_slots, np.int32)
-        for i, (s, t_) in enumerate(moves):
-            src[i] = s
-            dst_a[i] = t_
-        self.cache = self._kv_copy_fn(
-            self.cache, jnp.asarray(src), jnp.asarray(dst_a), block
-        )
         with self._lock:
             for s, dst in moves:
                 req = self.slot_req[s]
-                if req is None:  # aborted while the copy was in flight
+                if req is None:  # aborted since planning
                     continue
+                dst_retained = int(self.retained_len[dst])
+                dst_version = int(self.kv_version[dst])
+                dst_vlm = bool(self._slot_vlm[dst])
+                dst_tokens = self.seq_tokens[dst].copy()
                 self.slot_req[dst] = req
                 self.slot_req[s] = None
                 for arr in (
@@ -2034,12 +2225,18 @@ class GenEngine:
                 self.seq_tokens[dst] = self.seq_tokens[s]
                 self.retained_len[dst] = 0
                 self._reserved_until[dst] = 0.0
-                # the source keeps its cache row: it frees as a retained
-                # prefix (the migrated request's transcript so far)
+                # zero-copy remap: the request's KV follows it to `dst`
+                # through the page table, and `dst`'s old retained prefix
+                # (physical row + radix entry) re-homes at the vacated
+                # logical slot — nothing is destroyed, nothing moves
+                self.pool.swap(s, dst)
+                self.seq_tokens[s] = dst_tokens
                 self.retained_len[s] = (
-                    0 if self._slot_vlm[s] else self.lengths[s]
+                    0 if dst_vlm else dst_retained
                 )
-                self._slot_vlm[s] = False
+                self.kv_version[s] = dst_version
+                self._slot_vlm[s] = dst_vlm
+                self._reserved_until[s] = 0.0
                 self.stats["tier_migrations"] += 1
             self._state_dirty = True
 
@@ -2062,6 +2259,10 @@ class GenEngine:
             "temp": jnp.asarray(self.temperature),
             "top_p": jnp.asarray(self.top_p),
             "top_k": jnp.asarray(self.top_k),
+            # page table: logical slot -> physical cache row (migration
+            # remaps dirty the state, so this re-uploads exactly when it
+            # changes and never per dispatch)
+            "rows": jnp.asarray(self.pool.page_table),
         }
         self._state_dirty = False
         self.stats["state_syncs"] += 1
@@ -2200,6 +2401,7 @@ class GenEngine:
                         st["top_p"],
                         st["top_k"],
                         self._decode_key,
+                        st["rows"],
                         drafts,
                         dlens,
                         self.tier_start[t],
@@ -2238,6 +2440,7 @@ class GenEngine:
                     st["top_p"],
                     st["top_k"],
                     self._decode_key,
+                    st["rows"],
                     n,
                     self.tier_start[t],
                     self.tier_size[t],
@@ -2372,6 +2575,9 @@ class GenEngine:
                     self.slot_req[s] = None
                     self.retained_len[s] = (
                         0 if self._slot_vlm[s] else self.lengths[s]
+                    )
+                    self.pool.note_free(
+                        s, self.seq_tokens[s], int(self.retained_len[s])
                     )
                     to_finish.append((req, reason))
             if to_finish:

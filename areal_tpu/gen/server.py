@@ -124,6 +124,14 @@ class GenServer:
             rate_g.set(accepted / drafted if drafted else 0.0)
             for t, r in enumerate(eng.spec_acceptance_rates()):
                 rate_g.set(r, tier=str(t))
+            # unified radix/paged prefix cache (ISSUE 16): the global
+            # hit-rate over all admissions (device hits + host swap-ins);
+            # the underlying hits/misses/evictions/host_swaps counters
+            # ride the generic engine.stats mirror above
+            reg.gauge(
+                "prefix_cache_hit_rate",
+                "Admissions served from the radix/paged prefix cache",
+            ).set(eng.prefix_cache_hit_rate())
 
         reg.add_collector(_collect)
 
@@ -223,6 +231,10 @@ class GenServer:
             "stop_reason": r.stop_reason or "stop",
             "version": version,
             "trace_id": r.trace_id,
+            # prompt tokens served from resident K/V (radix device hit or
+            # host swap-in) — failover clients use this to confirm a
+            # resubmission warm-started instead of cold-prefilling
+            "cache_hit_tokens": r.cache_hit_tokens,
         }
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -498,6 +510,20 @@ class GenServer:
                     4,
                 ),
                 "verify_calls": stats.get("verify_calls", 0),
+                # unified radix/paged prefix cache (ISSUE 16): admission
+                # hits/misses through the one shared mechanism, device
+                # evictions, and host-DRAM spill/swap-in round trips
+                "prefix_cache_hits": stats.get("prefix_cache_hits", 0),
+                "prefix_cache_misses": stats.get("prefix_cache_misses", 0),
+                "prefix_cache_evictions": stats.get(
+                    "prefix_cache_evictions", 0
+                ),
+                "prefix_cache_host_swaps": stats.get(
+                    "prefix_cache_host_swaps", 0
+                ),
+                "prefix_cache_hit_rate": round(
+                    self.engine.prefix_cache_hit_rate(), 4
+                ),
             }
         )
 
@@ -583,6 +609,12 @@ def main():
     p.add_argument("--spec-draft-len", type=int, default=0,
                    help="pin the draft length instead of adapting along "
                         "the ladder (benches/tests)")
+    p.add_argument("--host-offload", action="store_true",
+                   help="spill evicted retained prefixes to a host-DRAM "
+                        "LRU tier and swap them back on radix hits")
+    p.add_argument("--host-cache-mb", type=int, default=64,
+                   help="host-DRAM overflow tier capacity in MiB "
+                        "(with --host-offload)")
     p.add_argument("--telemetry", action="store_true",
                    help="enable trajectory-lifecycle event emission "
                         "(utils/telemetry.py; also via AREAL_TELEMETRY=1)")
@@ -606,6 +638,8 @@ def main():
             if args.spec_ladder else None
         ),
         spec_draft_len=args.spec_draft_len or None,
+        host_offload=args.host_offload,
+        host_cache_mb=args.host_cache_mb,
     )
     if args.model_path:
         cfg = TransformerConfig.from_hf(args.model_path)

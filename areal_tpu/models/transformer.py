@@ -695,10 +695,15 @@ def forward_decode(
     key_window: Optional[int] = None,  # STATIC bucketed attended span
     slot_base: int = 0,  # STATIC first cache row of the dispatched block
     active: Optional[jax.Array] = None,  # bool [B]; False drops the KV write
+    rows: Optional[jax.Array] = None,  # int32 [B] physical rows (page table)
 ):
-    """One decode step for a contiguous block of `B` slots starting at cache
-    row `slot_base`; returns (logits [B, V], new cache).  The new token's
-    K/V is written at cache position `lengths[s]`.
+    """One decode step for a block of `B` slots; returns (logits [B, V],
+    new cache).  The new token's K/V is written at cache position
+    `lengths[s]`.  Rows are contiguous from `slot_base` by default; when
+    `rows` is given (ISSUE 16 paged pool) each logical slot reads and
+    writes THROUGH its page-table row instead — same program shape (rows
+    is traced data), so remapping a slot's physical row costs zero new
+    compilations and, with an identity table, zero numeric difference.
 
     `key_window` bounds attention, masks, and the cache write to the first
     K cache columns: decode FLOPs and HBM reads then track the occupied
@@ -745,7 +750,7 @@ def forward_decode(
             mask_win = win
         else:
             attn_mask = win
-    slots = slot_base + jnp.arange(B)
+    slots = rows if rows is not None else slot_base + jnp.arange(B)
     # clamp: a slot past its cache end (freed host-side mid-chunk, still
     # advancing in the fused decode scan) overwrites the window's last
     # column with garbage instead of stalling the whole grid (VERDICT r3
@@ -770,8 +775,12 @@ def forward_decode(
         # read only the block's rows and the attended window [0, K): the
         # cache keeps its full [S_total, M] shape, attention never touches
         # rows outside the tier or columns past the window
-        ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
-        cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        if rows is None:
+            ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
+            cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        else:
+            ckr = jnp.take(ck, rows, axis=0)
+            cvr = jnp.take(cv, rows, axis=0)
         attn = attention(
             q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
             cfg.attn_logit_softcap,
@@ -811,6 +820,7 @@ def forward_verify(
     slot_base: int = 0,  # STATIC first cache row of the dispatched block
     active: Optional[jax.Array] = None,  # bool [B]; False drops ALL KV writes
     n_write: Optional[jax.Array] = None,  # int32 [B] valid input positions
+    rows: Optional[jax.Array] = None,  # int32 [B] physical rows (page table)
 ):
     """Speculative-decode verification: score T input positions per slot of
     a contiguous tier block in ONE dispatch — the decode analogue of
@@ -862,7 +872,7 @@ def forward_verify(
             mask_win = win
         else:
             attn_mask = win
-    slots = slot_base + jnp.arange(B)
+    slots = rows if rows is not None else slot_base + jnp.arange(B)
     widx = jnp.minimum(positions, K - 1)
     keep = offs[None, :] < (
         jnp.full((B,), T, jnp.int32) if n_write is None else n_write
@@ -883,8 +893,12 @@ def forward_verify(
             k = apply_rope(k, cos, sin)
         ck = ck.at[slots[:, None], widx].set(k.astype(ck.dtype), mode="drop")
         cv = cv.at[slots[:, None], widx].set(v.astype(cv.dtype), mode="drop")
-        ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
-        cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        if rows is None:
+            ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
+            cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        else:
+            ckr = jnp.take(ck, rows, axis=0)
+            cvr = jnp.take(cv, rows, axis=0)
         attn = attention(
             q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
             cfg.attn_logit_softcap,

@@ -1,4 +1,4 @@
-"""Cross-slot KV prefix fan-out for grouped admission.
+"""Cross-slot KV prefix fan-out and device<->host prefix transfers.
 
 GRPO samples every group as `group_size` requests over the SAME prompt, and
 tree-search / multi-turn branches share a transcript prefix.  The engine
@@ -34,6 +34,54 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+
+
+def gather_kv_prefix(
+    cache: Dict[str, jax.Array],
+    row: jax.Array,  # int32 scalar: physical cache row to extract
+    block: int,  # STATIC bucketed prefix length (positions extracted)
+) -> Dict[str, jax.Array]:
+    """Extract cache positions [0, block) of one physical row for a
+    host-DRAM spill: {key: [L, block, Hkv, hd]}.
+
+    `row` is traced (one program per block bucket serves every slot) and
+    `block` rides the prompt-bucket ladder, so the spill path adds one
+    C6-budgeted program family of size `ladder`, not one per (slot, len).
+    The caller downloads the result with np.asarray — the only host sync
+    on the spill path, at the admission boundary where the engine already
+    syncs its planning state.
+    """
+    out = {}
+    for key, buf in cache.items():
+        rowbuf = jax.lax.dynamic_index_in_dim(
+            buf, row, axis=1, keepdims=False
+        )  # [L, M, Hkv, hd]
+        out[key] = jax.lax.slice_in_dim(rowbuf, 0, block, axis=1)
+    return out
+
+
+def scatter_kv_prefix(
+    cache: Dict[str, jax.Array],
+    host_kv: Dict[str, jax.Array],  # {key: [L, block, Hkv, hd]} from gather
+    row: jax.Array,  # int32 scalar: physical cache row to restore into
+) -> Dict[str, jax.Array]:
+    """Write a host-spilled prefix back into one physical row (swap-in on
+    a radix hit); returns the updated cache pytree (cache donated by the
+    engine's jit wrapper, so the restore is in-place on device).
+
+    The round trip is bit-identical: gather slices raw cache bytes, the
+    host keeps them in the cache dtype, and this scatter writes them back
+    untouched — a swapped-in prefix attends exactly like one that never
+    left HBM, which is what keeps counter-keyed streams invariant to
+    spill/swap scheduling.
+    """
+    out = {}
+    for key, buf in cache.items():
+        blk = host_kv[key].astype(buf.dtype)[:, None]  # [L, 1, block, ...]
+        out[key] = jax.lax.dynamic_update_slice(
+            buf, blk, (0, row, 0, 0, 0)
+        )
+    return out
 
 
 def copy_kv_prefix(

@@ -372,6 +372,14 @@ CLIENT_RESUBMISSIONS = TRAIN.counter(
     "Trajectories resubmitted to another server after a backend failure",
 )
 
+# A resubmit whose replacement server reported nonzero cache_hit_tokens:
+# the retried trajectory warm-started through the radix/paged prefix cache
+# (ISSUE 16) instead of cold-prefilling its accumulated tokens.
+CLIENT_RESUBMIT_CACHE_HITS = TRAIN.counter(
+    "areal_client_resubmit_cache_hits_total",
+    "Failover resubmits that warm-started via a prefix-cache hit",
+)
+
 # Incremented once per successful RecoverHandler.load — a relaunched run
 # resuming from a recover generation (utils/recover.py).  Registered at
 # import for the same early-visibility reason as above.

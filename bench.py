@@ -330,35 +330,45 @@ def main():
             m = _re.search(r"_r(\d+)\.json$", runs[-1])
             stale_round = f"r{m.group(1)}" if m else os.path.basename(runs[-1])
             carried = result.setdefault("stale_from", {})
+            # an e2e artifact may itself carry sections from an earlier
+            # round (a CPU-only round keeps the on-chip sections verbatim
+            # and lists them in its own stale_from) — the mark must name
+            # the round the number was MEASURED in, not the latest file
+            e2e_stale = e2e.get("stale_from", {})
 
-            def _carry(key, value):
+            def _carry(key, value, section=""):
                 result[key] = value
-                carried[key] = stale_round
+                carried[key] = e2e_stale.get(section, stale_round)
             # prefer the run BASELINE.json.published quotes: the
             # heterogeneous-length workload (its latest rerun), falling
             # back to the uniform-length live-swap run
             het = e2e.get("heterogeneous_length_live_swap", {})
-            live = (
-                het.get("rerun_after_warm_signature_fix")
-                or het
-                or e2e.get("publish_mode_live_swap")
-                or e2e
-            )
+            if het:
+                src = "heterogeneous_length_live_swap"
+                live = het.get("rerun_after_warm_signature_fix") or het
+            elif e2e.get("publish_mode_live_swap"):
+                src = "publish_mode_live_swap"
+                live = e2e["publish_mode_live_swap"]
+            else:
+                src = ""
+                live = e2e
             result["e2e_artifact"] = os.path.basename(runs[-1])
             _carry("e2e_async_trajs_per_sec_per_chip",
-                   live["async"]["trajs_per_sec_per_chip"])
+                   live["async"]["trajs_per_sec_per_chip"], src)
             _carry("e2e_async_over_sync",
-                   live["async_over_sync_trajs_per_sec"])
+                   live["async_over_sync_trajs_per_sec"], src)
             pause = live["async"].get("pause_window_s_mean")
             if pause is None:  # 0.0 is a real (sub-ms) measurement
                 pause = het.get("async", {}).get("pause_window_s_mean")
-            _carry("e2e_publish_pause_s", pause)
+            _carry("e2e_publish_pause_s", pause, src)
             mt = e2e.get("multi_turn_agentic")
             if mt:
                 _carry("e2e_multiturn_async_over_sync",
-                       mt["async_over_sync_trajs_per_sec"])
+                       mt["async_over_sync_trajs_per_sec"],
+                       "multi_turn_agentic")
                 _carry("e2e_multiturn_kv_reused_fraction",
-                       mt["kv_reuse"]["reused_fraction"])
+                       mt["kv_reuse"]["reused_fraction"],
+                       "multi_turn_agentic")
     except Exception as e:  # noqa: BLE001 — informational extras
         print(f"bench: e2e carry-over failed: {str(e)[:120]}",
               file=sys.stderr)

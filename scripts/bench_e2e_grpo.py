@@ -932,7 +932,7 @@ def main():
                          + st["reused_tokens"] + st["shared_tokens"])
         if args.workflow == "multi_turn":
             # later turns re-prefill only the suffix when the engine still
-            # holds the episode's KV prefix (gen/engine.py _slot_lcps)
+            # holds the episode's KV prefix (gen/kv_pool.py radix index)
             result["kv_reuse"] = {
                 "prefill_tokens": int(st["prefill_tokens"]),
                 "suffix_tokens": int(st["suffix_tokens"]),

@@ -78,6 +78,9 @@ def _boot_server(cfg, params, args):
         prompt_bucket=64,
         decode_chunk=8,
         share_prefix=True,
+        host_offload=args.host_offload,
+        host_cache_mb=args.host_cache_mb,
+        host_min_tokens=args.host_min_tokens,
     )
     server = GenServer(engine)
     server.start()
@@ -258,6 +261,40 @@ async def _warmup(addrs: List[str], *, vocab: int,
                     await resp.json()
 
 
+def _scrape_prefix_stats(addrs: List[str]) -> Dict[str, int]:
+    """Sum the radix/paged prefix-cache counters over the fleet's
+    /metrics JSON surfaces (works identically for self-hosted and
+    external backends)."""
+    import urllib.request
+
+    keys = ("prefix_cache_hits", "prefix_cache_misses",
+            "prefix_cache_evictions", "prefix_cache_host_swaps")
+    total = dict.fromkeys(keys, 0)
+    for addr in addrs:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}/metrics", timeout=5) as r:
+                m = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — external fleets may not expose it
+            continue
+        for k in keys:
+            total[k] += int(m.get(k, 0))
+    return total
+
+
+def _prefix_cache_delta(before: Dict[str, int],
+                        after: Dict[str, int]) -> Dict[str, Any]:
+    d = {k: after[k] - before[k] for k in before}
+    lookups = d["prefix_cache_hits"] + d["prefix_cache_misses"]
+    return {
+        "hits": d["prefix_cache_hits"],
+        "misses": d["prefix_cache_misses"],
+        "evictions": d["prefix_cache_evictions"],
+        "host_swaps": d["prefix_cache_host_swaps"],
+        "hit_rate": (d["prefix_cache_hits"] / lookups) if lookups else None,
+    }
+
+
 def _rate_summary(rate: float, arrivals: List[wl.Arrival],
                   results: List[Dict[str, Any]],
                   wall_s: float) -> Dict[str, Any]:
@@ -308,6 +345,13 @@ def main() -> int:
                    help="comma-separated arrival-rate multipliers (1-100x)")
     p.add_argument("--n-slots", type=int, default=8)
     p.add_argument("--max-seq-len", type=int, default=256)
+    p.add_argument("--host-offload", action="store_true",
+                   help="self-hosted servers spill evicted prefixes to a "
+                        "host-DRAM LRU tier (ISSUE 16)")
+    p.add_argument("--host-cache-mb", type=int, default=64,
+                   help="host overflow tier capacity per server, MiB")
+    p.add_argument("--host-min-tokens", type=int, default=32,
+                   help="minimum retained length worth spilling to host")
     p.add_argument("--max-new-tokens", type=int, default=16,
                    help="synthetic workload decode-budget ceiling")
     p.add_argument("--no-warmup", action="store_true",
@@ -387,6 +431,7 @@ def main() -> int:
 
     # replay -----------------------------------------------------------
     curve = []
+    run_prefix_cache: Optional[Dict[str, Any]] = None
     try:
         if not args.no_warmup:
             tw = time.perf_counter()
@@ -399,19 +444,28 @@ def main() -> int:
         # completeness linter
         if args.telemetry_dir:
             telemetry.set_enabled(True)
+        run_cache_before = _scrape_prefix_stats(warm_addrs)
         for rate in rates:
+            cache_before = _scrape_prefix_stats(warm_addrs)
             t0 = time.perf_counter()
             results = asyncio.run(_drive(
                 addr, arrivals, rate=rate, vocab=vocab, seed=args.seed,
                 timeout=args.timeout, max_seq_len=args.max_seq_len))
             wall = time.perf_counter() - t0
             summary = _rate_summary(rate, arrivals, results, wall)
+            # hit-rate-vs-latency: every point on the latency curve
+            # carries the prefix-cache composition that produced it
+            summary["prefix_cache"] = _prefix_cache_delta(
+                cache_before, _scrape_prefix_stats(warm_addrs))
             curve.append(summary)
             lat = summary["latency_s"] or {}
             print(f"rate x{rate:g}: ok={summary['ok']}/{summary['n']} "
                   f"p50={lat.get('p50')} p99={lat.get('p99')} "
-                  f"tok/s={summary['output_tokens_per_s']}",
+                  f"tok/s={summary['output_tokens_per_s']} "
+                  f"hit_rate={summary['prefix_cache']['hit_rate']}",
                   file=sys.stderr, flush=True)
+        run_prefix_cache = _prefix_cache_delta(
+            run_cache_before, _scrape_prefix_stats(warm_addrs))
     finally:
         for stop in reversed(stops):
             try:
@@ -425,6 +479,7 @@ def main() -> int:
         "fleet": fleet,
         "workload": wl.summarize(arrivals),
         "rates": curve,
+        "prefix_cache": run_prefix_cache,
     }
 
     if args.telemetry_dir:
@@ -439,6 +494,11 @@ def main() -> int:
             report = slo_mod.build_report(
                 events_path, run_id="replay",
                 source_name=events_path)
+            # the prefix-cache composition rides the SLO report so
+            # check_slo.py can band the global hit rate alongside the
+            # latency percentiles (baseline key: prefix_cache.hit_rate)
+            if run_prefix_cache is not None:
+                report["prefix_cache"] = run_prefix_cache
             with open(args.slo_report, "w") as f:
                 json.dump(report, f, indent=2)
                 f.write("\n")

@@ -274,15 +274,28 @@ def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
     (group fan-out prefill) vs no-share engine over the identical workload;
     reports wall clock plus the hardware-independent signal —
     `shared_prefill_fraction`: the fraction of grouped prompt tokens that
-    were NEVER recomputed (fanned out from the representative's KV)."""
+    were NEVER recomputed (fanned out from the representative's KV).
+
+    A third pass (`share_host`) reruns the share workload with the
+    host-DRAM overflow tier enabled and retained prefixes spilling between
+    groups; its streams must be bit-identical to the device-only share
+    pass — cache placement (device row, page remap, host round trip) is
+    invisible to the counter-keyed sampler."""
     from areal_tpu.gen.engine import GenRequest
 
     out = {"group_size": group_size, "n_groups": n_groups,
            "prompt_len": prompt_len}
-    for mode in ("share", "noshare"):
-        rng = np.random.default_rng(5)  # identical workload both modes
+    streams = {}  # mode -> [[output_tokens per sibling] per group]
+    mode_kw = {
+        "share": dict(share_prefix=True),
+        "noshare": dict(share_prefix=False),
+        "share_host": dict(share_prefix=True, host_offload=True,
+                           host_cache_mb=32, host_min_tokens=16),
+    }
+    for mode in ("share", "noshare", "share_host"):
+        rng = np.random.default_rng(5)  # identical workload all modes
         eng = _engine(cfg, params, group_size, max_seq_len,
-                      share_prefix=(mode == "share"))
+                      **mode_kw[mode])
 
         def run_group(prompt, tag):
             reqs = [
@@ -294,6 +307,7 @@ def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
             eng.submit_batch(reqs)
             while any(not r.stop_reason for r in reqs):
                 eng.step()
+            return reqs
 
         # warmup compiles every program the timed loop hits (prefill
         # bucket, fan-out copy, sibling suffix bucket, decode)
@@ -302,8 +316,15 @@ def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
         eng.retained_len[:] = 0  # no cross-group retained carryover
         t0 = time.perf_counter()
         for g in range(n_groups):
-            run_group(rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
-                      f"{mode}{g}")
+            # mode-independent tag: stream keys derive from the rid, so
+            # the share/noshare identity check needs identical rids
+            done = run_group(
+                rng.integers(0, cfg.vocab_size, prompt_len).tolist(),
+                f"g{g}",
+            )
+            streams.setdefault(mode, []).append(
+                [r.output_tokens for r in done]
+            )
         dt = time.perf_counter() - t0
         st = eng.stats
         total = (st["prefill_tokens"] + st["suffix_tokens"]
@@ -318,10 +339,20 @@ def bench_group_fanout(cfg, params, group_size=8, n_groups=6, prompt_len=256,
                 st["shared_tokens"] / max(total, 1), 4
             ),
         }
+        if mode == "share_host":
+            out[mode]["prefix_cache_host_swaps"] = st[
+                "prefix_cache_host_swaps"
+            ]
+            out[mode]["prefix_cache_evictions"] = st[
+                "prefix_cache_evictions"
+            ]
         print(f"group_fanout {mode}: {out[mode]}", file=sys.stderr,
               flush=True)
         del eng
     out["shared_prefill_fraction"] = out["share"]["shared_prefill_fraction"]
+    # the host tier must be invisible to the counter-keyed sampler: the
+    # share workload rerun under spill pressure emits the exact streams
+    out["streams_bit_identical"] = streams["share"] == streams["share_host"]
     out["speedup"] = round(
         out["noshare"]["wall_s"] / max(out["share"]["wall_s"], 1e-9), 3
     )

@@ -111,6 +111,11 @@ class FakeGenServer:
                 "output_logprobs": [-0.5] * len(out),
                 "stop_reason": stop,
                 "version": gen_version,
+                # the real engine reports how many prompt tokens hit the
+                # radix/paged prefix cache; the fake's analogue is the
+                # already-consumed completion carried back in the prompt
+                # (nonzero exactly on interruption/failover resubmits)
+                "cache_hit_tokens": done,
             }
         )
 

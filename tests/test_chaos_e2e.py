@@ -94,6 +94,22 @@ def test_kill_one_of_two_mid_run_completes_and_rejoins(enabled_telemetry):
         assert resubmits, "killing a loaded replica must trigger resubmits"
         assert all(e["trace_id"] in submits for e in resubmits)
 
+        # 2b. resubmissions warm-start through the prefix cache (ISSUE 16):
+        # the replacement server reports the accumulated tokens it served
+        # from resident/derivable state as cache_hit_tokens, and the client
+        # surfaces them as resubmit_cache_hit events + a counter — a
+        # retried trajectory must not silently cold-prefill
+        cache_hits = [e for e in events if e["event"] == "resubmit_cache_hit"]
+        assert cache_hits, (
+            "kill-one-of-two must report nonzero resubmit cache hits"
+        )
+        assert sum(e["hit_tokens"] for e in cache_hits) > 0
+        assert all(e["trace_id"] in submits for e in cache_hits)
+        counted = sum(
+            v for _, _, v in telemetry.CLIENT_RESUBMIT_CACHE_HITS.samples()
+        )
+        assert counted >= len(cache_hits)
+
         # 3. staleness ledger settled: capacity returns to the churn invariant
         stats = eng.executor.staleness_manager.get_stats()
         assert stats.running == 0

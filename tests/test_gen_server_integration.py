@@ -493,3 +493,14 @@ def test_generate_batch_groups_share_prefix(live_server):
     assert m["spec_acceptance_rate"] == 0.0
     assert m["verify_calls"] == 0
     assert m["tier_migrations"] >= 0
+    # unified prefix cache (ISSUE 16): the two siblings are hits through
+    # the radix pool, so the global hit-rate reflects them; each result
+    # reports its warm-started prompt span on the wire
+    assert m["prefix_cache_hits"] >= 2
+    assert m["prefix_cache_misses"] >= 1
+    assert 0.0 < m["prefix_cache_hit_rate"] <= 1.0
+    assert m["prefix_cache_evictions"] >= 0
+    assert m["prefix_cache_host_swaps"] == 0  # host tier off by default
+    hits = sorted(r["cache_hit_tokens"] for r in out["results"])
+    assert hits[0] == 0  # the representative cold-prefilled
+    assert hits[-1] >= len(prompt) - 1  # siblings rode its prefix K/V

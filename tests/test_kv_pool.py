@@ -1,0 +1,286 @@
+"""Unified radix/paged KV pool (ISSUE 16) — host-side unit tests.
+
+Everything here is pure numpy/Python (no jax, no engine): the radix tree's
+exact-lcp contract against a brute-force scan, edge splitting/pruning, the
+LRU byte accounting of the host overflow tier, and the page-table
+permutation invariant.  The engine-level behavior (paged decode parity,
+host-swap round trips) lives in test_paged_cache.py.
+"""
+
+import numpy as np
+import pytest
+
+from areal_tpu.gen.kv_pool import (
+    HostEntry,
+    HostOverflowTier,
+    KVPool,
+    RadixIndex,
+    lcp_ids,
+)
+
+
+# ------------------------------ lcp_ids --------------------------------
+
+
+def test_lcp_ids_basics():
+    assert lcp_ids([], []) == 0
+    assert lcp_ids([1, 2, 3], []) == 0
+    assert lcp_ids([1, 2, 3], [1, 2, 3]) == 3
+    assert lcp_ids([1, 2, 3], [1, 2, 4]) == 2
+    assert lcp_ids([1, 2], [1, 2, 9, 9]) == 2
+    assert lcp_ids([5], [7]) == 0
+
+
+# ----------------------------- RadixIndex ------------------------------
+
+
+def _brute_match(entries, ids):
+    return {k: lcp_ids(toks, ids) for k, toks in entries.items()}
+
+
+def test_radix_match_is_exact_lcp_for_every_entry():
+    idx = RadixIndex()
+    entries = {
+        "a": [1, 2, 3, 4],
+        "b": [1, 2, 3, 9],
+        "c": [1, 2],
+        "d": [7, 8],
+        "e": [1, 5, 6],
+    }
+    for k, t in entries.items():
+        idx.insert(k, t)
+    for query in (
+        [1, 2, 3, 4, 5],
+        [1, 2, 3],
+        [1, 2, 9],
+        [7, 8, 8],
+        [9],
+        [],
+        [1],
+        [1, 5, 6, 6],
+    ):
+        assert idx.match(query) == _brute_match(entries, query), query
+
+
+def test_radix_match_randomized_against_brute_force():
+    """The tree must reproduce the old vectorised seq_tokens scan bit for
+    bit on adversarial shared-prefix families."""
+    rng = np.random.default_rng(0)
+    idx = RadixIndex()
+    entries = {}
+    # families of sequences sharing staggered prefixes (the GRPO/multi-turn
+    # shape), over a tiny alphabet to force deep shared paths
+    for i in range(60):
+        base = rng.integers(0, 4, rng.integers(1, 12)).tolist()
+        if entries and rng.random() < 0.6:
+            donor = entries[rng.choice(list(entries))]
+            cut = int(rng.integers(0, len(donor) + 1))
+            base = list(donor[:cut]) + base
+        entries[i] = base[:24]
+        idx.insert(i, base[:24])
+    # random churn: removals keep the tree consistent
+    for i in list(entries)[::7]:
+        idx.remove(i)
+        del entries[i]
+    assert len(idx) == len(entries)
+    for _ in range(50):
+        q = rng.integers(0, 4, rng.integers(0, 20)).tolist()
+        assert idx.match(q) == _brute_match(entries, q)
+
+
+def test_radix_insert_reinsert_and_remove():
+    idx = RadixIndex()
+    idx.insert("x", [1, 2, 3])
+    assert "x" in idx and len(idx) == 1
+    assert idx.tokens("x").tolist() == [1, 2, 3]
+    # re-insert relocates rather than duplicating
+    idx.insert("x", [4, 5])
+    assert len(idx) == 1
+    assert idx.match([4, 5]) == {"x": 2}
+    assert idx.match([1, 2, 3]) == {"x": 0}
+    got = idx.remove("x")
+    assert got.tolist() == [4, 5]
+    assert idx.remove("x") is None
+    assert len(idx) == 0
+    # fully pruned: the root has no leftover children
+    assert not idx.root.children
+
+
+def test_radix_edge_split_preserves_existing_entries():
+    idx = RadixIndex()
+    idx.insert("long", [1, 2, 3, 4, 5])
+    idx.insert("mid", [1, 2, 3])  # lands mid-edge: forces a split
+    idx.insert("fork", [1, 2, 9])  # diverges inside the compressed edge
+    assert idx.match([1, 2, 3, 4, 5]) == {"long": 5, "mid": 3, "fork": 2}
+    assert idx.match([1, 2, 9, 9]) == {"long": 2, "mid": 2, "fork": 3}
+    idx.remove("mid")
+    assert idx.match([1, 2, 3, 4, 5]) == {"long": 5, "fork": 2}
+
+
+def test_radix_clear():
+    idx = RadixIndex()
+    for i in range(5):
+        idx.insert(i, [i, i + 1])
+    idx.clear()
+    assert len(idx) == 0 and idx.match([0, 1]) == {}
+
+
+# --------------------------- HostOverflowTier --------------------------
+
+
+def _entry(n_tokens, nbytes_per_tok=8):
+    kv = {"k": np.zeros((1, n_tokens, 1, nbytes_per_tok), np.uint8)}
+    return HostEntry(
+        tokens=np.arange(n_tokens, dtype=np.int64),
+        valid_len=n_tokens,
+        version=0,
+        block=n_tokens,
+        kv=kv,
+    )
+
+
+def test_host_tier_lru_evicts_by_bytes():
+    tier = HostOverflowTier(capacity_bytes=3 * 8 * 8)  # fits three 8-token
+    assert tier.put(0, _entry(8)) == []
+    assert tier.put(1, _entry(8)) == []
+    assert tier.put(2, _entry(8)) == []
+    assert tier.used_bytes == 3 * 64
+    # a fourth entry evicts the least recently used (hid 0)
+    assert tier.put(3, _entry(8)) == [0]
+    assert 0 not in tier and 1 in tier
+    # touching 1 promotes it: the next eviction takes 2 instead
+    tier.touch(1)
+    assert tier.put(4, _entry(8)) == [2]
+    assert 1 in tier
+    assert tier.used_bytes == 3 * 64
+
+
+def test_host_tier_refuses_oversized_entry():
+    tier = HostOverflowTier(capacity_bytes=100)
+    tier.put(0, _entry(4))  # 32 bytes, fits
+    # an entry larger than the whole tier is its own eviction; the
+    # resident entries are NOT flushed for nothing
+    assert tier.put(1, _entry(32)) == [1]
+    assert 0 in tier and 1 not in tier
+
+
+def test_host_tier_take_and_clear():
+    tier = HostOverflowTier(capacity_bytes=1 << 20)
+    tier.put(0, _entry(8))
+    ent = tier.take(0)
+    assert ent is not None and ent.valid_len == 8
+    assert tier.take(0) is None
+    assert tier.used_bytes == 0
+    tier.put(1, _entry(8))
+    tier.put(2, _entry(8))
+    assert tier.clear() == 2
+    assert tier.used_bytes == 0 and len(tier) == 0
+
+
+# -------------------------------- KVPool -------------------------------
+
+
+def test_pool_page_table_swap_rehomes_radix_entries():
+    pool = KVPool(n_slots=4)
+    seq = np.arange(10, dtype=np.int64)
+    pool.note_free(0, seq, 6)
+    pool.note_free(2, seq + 50, 4)
+    assert pool.match_device(seq[:6].tolist()) == {0: 6, 2: 0}
+    r0, r2 = pool.row(0), pool.row(2)
+    pool.swap(0, 2)
+    # physical rows swapped, and the indexed prefixes moved WITH them
+    assert pool.row(0) == r2 and pool.row(2) == r0
+    assert pool.match_device(seq[:6].tolist()) == {2: 6, 0: 0}
+    assert pool.device_tokens(2).tolist() == seq[:6].tolist()
+    pool.check_page_table()
+    # swap involving an entry-less slot keeps the tree consistent: slot 2's
+    # entry moves to slot 1, slot 2 ends up entry-less
+    pool.swap(1, 2)
+    assert pool.match_device(seq[:6].tolist()) == {1: 6, 0: 0}
+    assert pool.device_tokens(2) is None
+    pool.check_page_table()
+
+
+def test_pool_random_swaps_stay_a_permutation():
+    rng = np.random.default_rng(1)
+    pool = KVPool(n_slots=8)
+    for _ in range(100):
+        a, b = rng.integers(0, 8, 2)
+        pool.swap(int(a), int(b))
+        pool.check_page_table()
+    # scratch row (index n_slots) is never remapped by slot swaps
+    assert pool.row(8) == 8
+
+
+def test_pool_note_free_and_drop_device():
+    pool = KVPool(n_slots=2)
+    seq = np.arange(16, dtype=np.int64)
+    pool.note_free(0, seq, 8)
+    assert pool.drop_device(0) == 8
+    assert pool.drop_device(0) == 0  # already dropped
+    pool.note_free(0, seq, 8)
+    pool.note_free(0, seq, 0)  # zero retained removes the entry
+    assert pool.device_tokens(0) is None
+
+
+def test_pool_host_put_take_and_radix_visibility():
+    pool = KVPool(n_slots=2, host_bytes=1 << 20)
+    toks = np.arange(12, dtype=np.int64)
+    kv = {"k": np.zeros((1, 16, 1, 4), np.float32)}
+    assert pool.host_put(toks, 12, version=3, block=16, kv=kv) == 0
+    m = pool.match_host(toks.tolist() + [99])
+    assert list(m.values()) == [12]
+    hid = next(iter(m))
+    ent = pool.host_take(hid)
+    assert ent.valid_len == 12 and ent.version == 3 and ent.block == 16
+    assert ent.tokens.tolist() == toks.tolist()
+    # taken for swap-in: gone from the host tier AND the radix
+    assert pool.match_host(toks.tolist()) == {}
+    assert pool.host_take(hid) is None
+
+
+def test_pool_host_lru_eviction_counts_and_unindexes():
+    # capacity for exactly two of these entries
+    kv_bytes = int(
+        np.zeros((1, 16, 1, 4), np.float32).nbytes
+    )
+    pool = KVPool(n_slots=2, host_bytes=2 * kv_bytes)
+    def put(base):
+        toks = np.arange(base, base + 12, dtype=np.int64)
+        return pool.host_put(
+            toks, 12, version=0, block=16,
+            kv={"k": np.zeros((1, 16, 1, 4), np.float32)},
+        )
+    assert put(0) == 0
+    assert put(100) == 0
+    assert put(200) == 1  # LRU evicted the first spill
+    assert pool.match_host(list(range(0, 12))) == {} or max(
+        pool.match_host(list(range(0, 12))).values()
+    ) == 0
+    assert len(pool.host) == 2
+
+
+def test_pool_clear_and_reset():
+    pool = KVPool(n_slots=2, host_bytes=1 << 20)
+    pool.note_free(0, np.arange(8, dtype=np.int64), 8)
+    pool.host_put(
+        np.arange(8, dtype=np.int64), 8, version=0, block=8,
+        kv={"k": np.zeros((1, 8, 1, 2), np.float32)},
+    )
+    pool.swap(0, 1)
+    pool.clear()
+    assert pool.match_device(list(range(8))) == {}
+    assert pool.match_host(list(range(8))) == {}
+    # clear keeps the page table (cache rows still hold live K/V) ...
+    assert pool.row(0) == 1
+    # ... reset restores identity (cache reallocated)
+    pool.reset()
+    assert pool.row(0) == 0 and pool.row(1) == 1
+    pool.check_page_table()
+
+
+def test_pool_check_page_table_catches_corruption():
+    pool = KVPool(n_slots=2)
+    pool.page_table[0] = 1  # duplicate row: slots 0 and 1 alias
+    with pytest.raises(AssertionError):
+        pool.check_page_table()
