@@ -344,6 +344,11 @@ class RemoteInfEngine(InferenceEngine):
         stop_reason = None
         ttft = float("inf")
         resubmitted = False  # next /generate is a failover resubmission
+        # counter-keyed sampler stream (ISSUE 17): the first response pins
+        # it; interruption resumes and failover resubmits pass it back so
+        # the continuation samples the exact keys the uninterrupted run
+        # would have used, on any server
+        stream_id = 0
 
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(
@@ -370,6 +375,8 @@ class RemoteInfEngine(InferenceEngine):
                         prompt_len=len(req.input_ids),
                     )
                 http_req = self.backend.build_generation_request(req)
+                if stream_id:
+                    http_req.payload["stream_id"] = stream_id
                 next_addr: Optional[str] = None
                 with self._lock:
                     self._inflight[addr] = self._inflight.get(addr, 0) + 1
@@ -433,6 +440,8 @@ class RemoteInfEngine(InferenceEngine):
                     addr = next_addr
                     continue
                 result = self.backend.parse_generation_response(raw)
+                if isinstance(raw, dict):
+                    stream_id = int(raw.get("stream_id", stream_id) or stream_id)
                 if resubmitted:
                     # did the retried trajectory warm-start on the new
                     # server's radix cache instead of cold-prefilling?

@@ -212,6 +212,13 @@ class GenRequest:
     # (retained reuse, fan-out share, or host swap-in) — surfaced on the
     # wire so a failover resubmit can prove its radix warm start
     cache_hit_tokens: int = 0
+    # sampler stream override (disaggregated handoff): 0 means "allocate a
+    # fresh stream at admission" (the normal path); nonzero pins the
+    # counter-keyed sampler stream so a decode-role server continues a
+    # prefill-role server's token stream bit-identically — the per-token
+    # key is fold(fold(decode_key, stream_id), position), a pure function
+    # of data that rides the wire
+    stream_id: int = 0
     on_done: Optional[Callable[["GenRequest"], None]] = None
 
     def finish(self, reason: str):
@@ -589,6 +596,21 @@ class GenEngine:
             "prefix_cache_misses": 0,
             "prefix_cache_evictions": 0,
             "prefix_cache_host_swaps": 0,
+            # page-granular sub-prefix sharing (ISSUE 17 satellite): hits
+            # whose inherited span is a page-rounded PARTIAL prefix copied
+            # from a donor slot that a longer match claimed — counted
+            # inside prefix_cache_hits too, this key is the breakdown
+            "prefix_cache_partial_hits": 0,
+            # disaggregated handoff (ISSUE 17): cross-server KV page
+            # streaming.  exports/imports count /kv_export gathers and
+            # /kv_import host-tier installs; bytes is the wire KV payload
+            # both ways; failures are export misses (prefix no longer
+            # resident) or imports refused (host tier disabled).  The
+            # server mirrors them as areal_gen_kv_handoff_*_total.
+            "kv_handoff_exports": 0,
+            "kv_handoff_imports": 0,
+            "kv_handoff_bytes": 0,
+            "kv_handoff_failures": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -600,21 +622,45 @@ class GenEngine:
         self.decode_chunk = max(1, decode_chunk)
         cfg = self.model_config
 
-        def _prefill(params, cache, ids, plen, slot_ids, rng, temp, tp, tk):
+        def _stream_keys(decode_key, streams, pos):
+            # counter-keyed sampling shared by every text prefill path:
+            # key = fold(fold(decode_key, stream), position) — the SAME
+            # scheme decode chunks use, with `pos` the index of the last
+            # WRITTEN token (one before the first decode key), so the
+            # whole token stream is a pure function of (stream_id,
+            # position).  That makes the stream invariant to placement:
+            # a fresh prefill here, a suffix resume after failover, or a
+            # cross-server handoff import all sample identical tokens.
+            return jax.vmap(
+                lambda s, p: jax.random.fold_in(
+                    jax.random.fold_in(decode_key, s), p
+                )
+            )(streams, pos)
+
+        def _prefill(
+            params, cache, ids, plen, slot_ids, streams, decode_key,
+            temp, tp, tk,
+        ):
             logits, cache = forward_prefill(params, cfg, ids, plen, cache, slot_ids)
-            tok, logp = sample_tokens(logits.astype(jnp.float32), rng, temp, tk, tp)
+            keys = _stream_keys(decode_key, streams, plen - 1)
+            tok, logp = sample_tokens_keyed(
+                logits.astype(jnp.float32), keys, temp, tk, tp
+            )
             return tok, logp, cache
 
         def _suffix_prefill(
             params, cache, ids, starts, slens, slot_ids, copy_src,
-            rng, temp, tp, tk, copy_block, key_window,
+            streams, decode_key, temp, tp, tk, copy_block, key_window,
         ):
             logits, cache = forward_prefill_cached(
                 params, cfg, ids, starts, slens, cache, slot_ids,
                 copy_src=copy_src, copy_block=copy_block,
                 key_window=key_window,
             )
-            tok, logp = sample_tokens(logits.astype(jnp.float32), rng, temp, tk, tp)
+            keys = _stream_keys(decode_key, streams, starts + slens - 1)
+            tok, logp = sample_tokens_keyed(
+                logits.astype(jnp.float32), keys, temp, tk, tp
+            )
             return tok, logp, cache
 
         def _decode_chunk(
@@ -770,38 +816,70 @@ class GenEngine:
             out = jnp.stack([sampled.T.astype(jnp.float32), logp.T])
             return out, n_emit, cache, tokens, lengths, rope_pos
 
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
+        # ONE cache aval family for every program (ISSUE 17): each
+        # cache-producing program pins its cache output to the SAME
+        # NamedSharding device_put installed at init (kv-head axis on
+        # "tp"), so device_put-fresh, prefill-, decode-, and
+        # scatter-produced caches are signature-identical.  Without the
+        # pin XLA infers PartitionSpec() for program outputs, splitting
+        # every downstream jit into a cold (device_put) and a resident
+        # family — the PR 16 cold-start re-mint — and silently degrading
+        # the kv-head sharding under tp>1.
+        rep = NamedSharding(self.mesh, P())
+        cache_sh = {
+            k: NamedSharding(self.mesh, self._cache_spec)
+            for k in self.cache
+        }
+        self._prefill_fn = jax.jit(
+            _prefill, donate_argnums=(1,),
+            out_shardings=(rep, rep, cache_sh),
+        )
         # the suffix program carries the cross-slot prefix fan-out fused in
         # (ops/kv_copy.py gather/scatter before the layer scan): copy_block
         # is static and always from the prompt-bucket ladder, so compile
         # count stays O(log^2 buckets x log slots), same family as
         # admission — and a grouped pass costs no extra dispatch
         self._suffix_prefill_fn = jax.jit(
-            _suffix_prefill, static_argnums=(11, 12), donate_argnums=(1,)
+            _suffix_prefill, static_argnums=(12, 13), donate_argnums=(1,),
+            out_shardings=(rep, rep, cache_sh),
         )
         # signature family: (tier block, chunk, K bucket) — tiers and
         # chunk are fixed per engine, K rides the pow2 prompt-bucket
         # ladder, so steady state compiles O(tiers x log(M/quantum))
         # programs and then mints none (pinned by test); the page-table
         # rows arg is traced data and adds no signatures
-        self._decode_fn = jax.jit(_decode_chunk, static_argnums=(12, 13, 14, 15),
-                                  donate_argnums=(1, 2, 3, 4))
+        self._decode_fn = jax.jit(
+            _decode_chunk, static_argnums=(12, 13, 14, 15),
+            donate_argnums=(1, 2, 3, 4),
+            out_shardings=(rep, cache_sh, rep, rep, rep),
+        )
         # verify signature family: (tier block, K bucket, D rung) — D
         # rides the small static spec ladder (D=0 reuses the decode
         # program outright), so spec decode adds
         # tiers x ladder x |nonzero rungs| programs at most, budgeted in
         # analysis/signature_budget.json ("verify") and pinned by the
         # jit-cache soak tests
-        self._verify_fn = jax.jit(_verify_chunk, static_argnums=(14, 15, 16, 17),
-                                  donate_argnums=(1, 2, 3, 4))
+        self._verify_fn = jax.jit(
+            _verify_chunk, static_argnums=(14, 15, 16, 17),
+            donate_argnums=(1, 2, 3, 4),
+            out_shardings=(rep, rep, cache_sh, rep, rep, rep),
+        )
         # host-DRAM overflow tier (ISSUE 16): spill gathers one physical
         # row's bucketed prefix (block static on the prompt ladder — one
         # program per bucket); swap-in scatters it back shape-keyed (same
         # ladder bound), with the cache donated so the restore is in-place
         self._host_gather_fn = jax.jit(gather_kv_prefix, static_argnums=(2,))
-        self._host_scatter_fn = jax.jit(scatter_kv_prefix,
-                                        donate_argnums=(0,))
+        # out_shardings pins the scatter-produced cache to the SAME layout
+        # device_put installed at init (kv-head axis on "tp"), so a swap-in
+        # or handoff import never changes the cache aval the decode family
+        # compiled against — the PR 16 cold-start re-mint is gone, and tp>1
+        # swap-ins keep the sharded layout instead of silently gathering
+        self._host_scatter_fn = jax.jit(
+            scatter_kv_prefix, donate_argnums=(0,),
+            out_shardings=NamedSharding(self.mesh, self._cache_spec),
+        )
         self._init_vlm()
+        self._warmup_host_tier()
 
     def _init_vlm(self) -> None:
         """Compile the vision tower + image-conditioned prefill when the
@@ -850,7 +928,16 @@ class GenEngine:
             return tok, logp, cache
 
         self._embed_images_fn = jax.jit(_embed_images)
-        self._vlm_prefill_fn = jax.jit(_vlm_prefill, donate_argnums=(1,))
+        # same single cache aval family as the text programs
+        rep = NamedSharding(self.mesh, P())
+        cache_sh = {
+            k: NamedSharding(self.mesh, self._cache_spec)
+            for k in self.cache
+        }
+        self._vlm_prefill_fn = jax.jit(
+            _vlm_prefill, donate_argnums=(1,),
+            out_shardings=(rep, rep, cache_sh),
+        )
 
     # ------------------------------------------------------------------
     # submission / weights
@@ -1315,6 +1402,138 @@ class GenEngine:
             slot_of_entry[i] = (s, best_l)
             reuse_admitted.append((s, req, best_l, s, False))
 
+    def _warmup_host_tier(self) -> None:
+        """Pre-compile the host-tier transfer family from COLD (the PR 16
+        cold-start caveat, ISSUE 17 satellite): one gather -> host ->
+        scatter round trip of the scratch row per block bucket, run at
+        init before any serving dispatch.  Afterwards every gather/scatter
+        rung is compiled AND the cache is already scatter-produced (with
+        `out_shardings` keeping its aval identical to the device_put one),
+        so the first real spill, swap-in, or handoff import mid-serving
+        mints nothing — the signature soak asserts this starting cold."""
+        if self.pool.host is None or self.cache is None:
+            return
+        row = jnp.asarray(self.pool.row(self.n_slots), jnp.int32)
+        v = 1
+        while True:
+            b = round_up_to_bucket(v, self.prompt_bucket, self.max_seq_len)
+            kv_dev = self._host_gather_fn(self.cache, row, b)
+            # areal-lint: disable=host-sync warmup-only: one scratch-row round trip per block bucket before serving starts
+            kv = {k: np.asarray(a) for k, a in kv_dev.items()}
+            self.cache = self._host_scatter_fn(
+                self.cache, {k: jnp.asarray(a) for k, a in kv.items()}, row
+            )
+            if b >= self.max_seq_len:
+                break
+            v = b + 1
+
+    # ------------------------------------------------------------------
+    # disaggregated handoff (ISSUE 17): cross-server KV page streaming
+    # ------------------------------------------------------------------
+
+    def export_request_kv(self, input_ids: List[int]) -> Optional[dict]:
+        """Serialize the resident KV prefix covering `input_ids` for a
+        cross-server handoff (/kv_export).  Walks the radix for the best
+        device-retained match first (normally the just-finished leg's own
+        slot), then the host tier; gathers the covered span on the bucket
+        ladder — the SAME host_gather program family the spill path uses,
+        zero new steady-state signatures — and returns a host-tier-format
+        entry {tokens, valid_len, version, block, kv} the importing
+        engine installs verbatim.  Non-destructive: the donor prefix
+        stays resident here, so a failed import loses nothing.  Returns
+        None (counting a failure) when nothing covering at least
+        reuse_min_tokens is resident; the router then continues the
+        stream colocated, which the counter-keyed sampler keeps
+        bit-identical anyway.
+
+        Thread contract: worker thread only (the server's handoff
+        mailbox) — radix walks and the donated cache ref are
+        worker-owned."""
+        limit = len(input_ids) - 1
+        best_slot, best_l = None, 0
+        if self.cache is not None:
+            for s, l in self.pool.match_device(input_ids).items():
+                toks = self.pool.device_tokens(s)
+                if toks is None or len(toks) != int(self.retained_len[s]):
+                    continue
+                l = min(int(l), limit)
+                if l > best_l:
+                    best_slot, best_l = s, l
+        if best_slot is not None and best_l >= self.reuse_min_tokens:
+            block = round_up_to_bucket(
+                best_l, self.prompt_bucket, self.max_seq_len
+            )
+            kv_dev = self._host_gather_fn(
+                self.cache,
+                jnp.asarray(self.pool.row(best_slot), jnp.int32),
+                block,
+            )
+            # areal-lint: disable=host-sync delivery point: handoff export download — one bucketed row gather per /kv_export
+            kv = {k: np.asarray(a) for k, a in kv_dev.items()}
+            entry = {
+                "tokens": np.asarray(
+                    self.pool.device_tokens(best_slot)[:best_l], np.int64
+                ),
+                "valid_len": int(best_l),
+                "version": int(self.kv_version[best_slot]),
+                "block": int(block),
+                "kv": kv,
+            }
+        else:
+            best_hid, best_hl = None, 0
+            if self.pool.host is not None:
+                for hid, l in self.pool.match_host(input_ids).items():
+                    ent = self.pool.host_entry(hid)
+                    if ent is None:
+                        continue
+                    l = min(int(l), ent.valid_len, limit)
+                    if l > best_hl:
+                        best_hid, best_hl = hid, l
+            if best_hid is None or best_hl < self.reuse_min_tokens:
+                self.stats["kv_handoff_failures"] += 1
+                return None
+            ent = self.pool.host_entry(best_hid)
+            self.pool.host.touch(best_hid)
+            # a partial host match exports the entry's full block; the
+            # importer attends nothing past valid_len, so the extra
+            # positions are dead weight, never wrong bytes
+            entry = {
+                "tokens": np.asarray(ent.tokens[:best_hl], np.int64),
+                "valid_len": int(best_hl),
+                "version": int(ent.version),
+                "block": int(ent.block),
+                "kv": ent.kv,
+            }
+        self.stats["kv_handoff_exports"] += 1
+        self.stats["kv_handoff_bytes"] += sum(
+            int(a.nbytes) for a in entry["kv"].values()
+        )
+        return entry
+
+    def import_request_kv(self, entry: dict) -> bool:
+        """Install an exported prefix (/kv_import) as a host-tier entry;
+        the request that follows admits through the ordinary radix match
+        + swap-in path as a warm-cache hit, re-scattering the pages on
+        the same bucket ladder — a bit-identical round trip, exactly like
+        a local spill.  Returns False (counting a failure) when the host
+        tier is disabled; decode-role servers always enable it (--role
+        decode forces host_offload).  Worker thread only, like export."""
+        if self.pool.host is None:
+            self.stats["kv_handoff_failures"] += 1
+            return False
+        tokens = np.asarray(entry["tokens"], np.int64)
+        vlen = int(entry["valid_len"])
+        kv = {k: np.asarray(a) for k, a in entry["kv"].items()}
+        evicted = self.pool.host_put(
+            tokens, vlen, int(entry["version"]), int(entry["block"]), kv
+        )
+        self.stats["prefix_cache_evictions"] += evicted
+        self.stats["kv_handoff_imports"] += 1
+        self.stats["kv_handoff_bytes"] += sum(
+            int(a.nbytes) for a in kv.values()
+        )
+        return True
+
     def _apply_group_hold(self, entries: List[tuple]):
         """Park members of a declared group (`group_id` + `group_n`) until
         the whole group shares one admission window — the cluster fan-out
@@ -1520,6 +1739,8 @@ class GenEngine:
         free_set = set(free)
         matched: set = set()
         slot_of_entry: Dict[int, tuple] = {}  # entry idx -> (slot, lcp)
+        cands: List[tuple] = []  # (-lcp, entry idx, slot), sorted
+        dev_claimed: set = set()  # slots won by a device-retained match
         if self.kv_reuse:
             # global matching through the radix index: ONE tree walk per
             # request returns the exact lcp against every resident prefix
@@ -1536,7 +1757,6 @@ class GenEngine:
                 and self.retained_len[s] >= self.reuse_min_tokens
             }
             if cand_set:
-                cands: List[tuple] = []
                 for i, (req, is_vlm) in enumerate(
                     entries[: self.match_window]
                 ):
@@ -1565,12 +1785,36 @@ class GenEngine:
                         continue
                     matched.add(i)
                     free_set.remove(s)
+                    dev_claimed.add(s)
                     slot_of_entry[i] = (s, -negl)
                     reuse_admitted.append((s, entries[i][0], -negl, s, False))
         if self.kv_reuse and self.pool.host is not None and free_set:
             self._swap_in_host_hits(
                 entries, matched, free_set, slot_of_entry, reuse_admitted
             )
+
+        # page-granular sub-prefix sharing (ISSUE 17 satellite): a request
+        # whose best device match LOST its donor slot to a longer match
+        # can still inherit the donor's prefix up to a page
+        # (prompt-bucket) boundary — the fused fan-out copy duplicates
+        # rows [0, span) of the donor's physical row into the loser's own
+        # slot before the layer scan, and the loser suffix-prefills from
+        # span on.  Safe by construction: the donor is CLAIMED this pass
+        # (never handed to a fresh prompt, so its retained K/V survives
+        # until the suffix dispatch) and its winner writes only from its
+        # own lcp >= the loser's lcp >= span, so the copy reads settled
+        # K/V even inside the one shared dispatch.  Exact-lcp IN-PLACE
+        # partial hits (the greedy winners above) are untouched — page
+        # rounding applies only to this new copy-based share path.
+        partial_of: Dict[int, tuple] = {}  # entry idx -> (donor slot, span)
+        if self.share_prefix and dev_claimed:
+            page = self.prompt_bucket
+            for negl, i, s in cands:  # still sorted: longest span first
+                if i in matched or i in partial_of or s not in dev_claimed:
+                    continue
+                span = ((-negl) // page) * page
+                if span >= self.share_min_tokens:
+                    partial_of[i] = (s, span)
 
         clusters: List[dict] = (
             self._plan_clusters(entries, matched) if self.share_prefix else []
@@ -1652,6 +1896,13 @@ class GenEngine:
                 )
             elif is_vlm:
                 vlm_admitted.append((s, req))
+            elif i in partial_of:
+                # partial rows never become cluster representatives: their
+                # copied span settles only inside the suffix dispatch, too
+                # late for a sibling's fused copy to read
+                donor, span = partial_of[i]
+                self.stats["prefix_cache_partial_hits"] += 1
+                shared_admitted.append((s, req, span, donor, True))
             else:
                 admitted.append((s, req))
                 if cid is not None:
@@ -1750,6 +2001,30 @@ class GenEngine:
             cold_tokens=total - int(inherited),
         )
 
+    def _assign_streams(
+        self, reqs: List[GenRequest], n_rows: int
+    ) -> np.ndarray:
+        """Counter-keyed sampler streams for one admission batch, assigned
+        BEFORE the prefill dispatch (the batch's first sampled token is
+        already stream-keyed).  Fresh requests draw from the shared
+        allocator in batch (arrival) order — the partition-invariance
+        contract — while a nonzero req.stream_id (a disaggregated handoff
+        continuing another server's stream) is honored verbatim.
+        Allocated ids are written back to req.stream_id so a prefill-role
+        server can hand its stream over the wire.  Pad rows keep stream 0
+        (never allocated; their samples land in the scratch slot and are
+        discarded)."""
+        streams = np.zeros(n_rows, np.int32)
+        with self._lock:
+            for i, req in enumerate(reqs):
+                if req.stream_id:
+                    streams[i] = req.stream_id
+                else:
+                    streams[i] = self._next_stream
+                    self._next_stream += 1
+                    req.stream_id = int(streams[i])
+        return streams
+
     def _admit_fresh_batch(self, admitted: List[tuple]) -> None:
         """Full prefill for prompts with no reusable prefix anywhere: ONE
         bucketed forward_prefill call (pow2 rows, scratch-slot padding)."""
@@ -1773,14 +2048,15 @@ class GenEngine:
             temp[i] = req.temperature
             top_p[i] = req.top_p
             top_k[i] = req.top_k
-        self.rng, sub = jax.random.split(self.rng)
+        streams = self._assign_streams([r for _, r in admitted], S)
         toks, logps, self.cache = self._prefill_fn(
             self.params,
             self.cache,
             ids,
             jnp.asarray(plens),
             jnp.asarray(slot_ids),
-            sub,
+            jnp.asarray(streams),
+            self._decode_key,
             jnp.asarray(temp),
             jnp.asarray(top_p),
             jnp.asarray(top_k),
@@ -1806,10 +2082,10 @@ class GenEngine:
                 self._reserved_until[s] = 0.0
                 self._slot_vlm[s] = False
                 self.kv_version[s] = self.version
-                # decode-key stream: assigned in batch (arrival) order so
-                # sampled streams are identical however slots are tiered
-                self.stream_ids[s] = self._next_stream
-                self._next_stream += 1
+                # decode-key stream: assigned in batch (arrival) order by
+                # _assign_streams so sampled streams are identical however
+                # slots are tiered (or pinned by a handoff's stream_id)
+                self.stream_ids[s] = streams[i]
                 n = len(req.input_ids)
                 self.seq_tokens[s, :n] = req.input_ids
             self._state_dirty = True
@@ -1874,7 +2150,7 @@ class GenEngine:
             self.prompt_bucket,
             self.max_seq_len,
         )
-        self.rng, sub = jax.random.split(self.rng)
+        streams = self._assign_streams([r for _, r, *_ in batch], S)
         toks, logps, self.cache = self._suffix_prefill_fn(
             self.params,
             self.cache,
@@ -1883,7 +2159,8 @@ class GenEngine:
             jnp.asarray(slens),
             jnp.asarray(slot_ids),
             jnp.asarray(copy_src),
-            sub,
+            jnp.asarray(streams),
+            self._decode_key,
             jnp.asarray(temp),
             jnp.asarray(top_p),
             jnp.asarray(top_k),
@@ -1923,8 +2200,7 @@ class GenEngine:
                 self.kv_version[s] = min(
                     int(self.kv_version[kv_src]), self.version
                 )
-                self.stream_ids[s] = self._next_stream
-                self._next_stream += 1
+                self.stream_ids[s] = streams[i]
                 self.seq_tokens[s, :n_total] = req.input_ids
             self._state_dirty = True
         for i, (s, req, _, _, _) in enumerate(batch):

@@ -35,6 +35,7 @@ touches jax, so the admission planner stays free of device syncs and the
 static-shape discipline of the compiled programs is untouched.
 """
 
+import base64
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -49,6 +50,61 @@ def lcp_ids(a, b) -> int:
         return 0
     neq = np.asarray(a[:m], np.int64) != np.asarray(b[:m], np.int64)
     return int(neq.argmax()) if neq.any() else m
+
+
+# ------------------------ handoff wire format --------------------------
+# Cross-server KV page streaming (ISSUE 17): an exported prefix travels
+# as JSON — token ids, the host-tier metadata, and each KV array as raw
+# bytes base64'd with dtype+shape.  No float conversion anywhere, so an
+# export -> wire -> import -> swap-in chain lands byte-for-byte the same
+# cache content a local spill/swap-in round trip would (the exactness
+# argument for disaggregated handoff rests on this plus the counter-keyed
+# sampler streams).
+
+
+def _wire_array(a: np.ndarray) -> Dict:
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _unwire_array(doc: Dict) -> np.ndarray:
+    flat = np.frombuffer(
+        base64.b64decode(doc["b64"]), dtype=np.dtype(doc["dtype"])
+    )
+    return flat.reshape(doc["shape"])
+
+
+def wire_encode_entry(entry: Dict) -> Dict:
+    """JSON-safe wire form of an exported KV entry (the /kv_export
+    response body / /kv_import request body)."""
+    kv = {k: np.asarray(v) for k, v in entry["kv"].items()}
+    return {
+        "tokens": [int(t) for t in entry["tokens"]],
+        "valid_len": int(entry["valid_len"]),
+        "version": int(entry["version"]),
+        "block": int(entry["block"]),
+        # payload size before base64 inflation — the router's transfer
+        # ledger and the handoff telemetry read this
+        "nbytes": int(sum(a.nbytes for a in kv.values())),
+        "kv": {k: _wire_array(a) for k, a in kv.items()},
+    }
+
+
+def wire_decode_entry(doc: Dict) -> Dict:
+    """Inverse of wire_encode_entry; KV arrays come back bit-identical
+    (read-only views over the decoded buffer — the import path never
+    mutates them)."""
+    return {
+        "tokens": np.asarray(doc["tokens"], np.int64),
+        "valid_len": int(doc["valid_len"]),
+        "version": int(doc["version"]),
+        "block": int(doc["block"]),
+        "kv": {k: _unwire_array(v) for k, v in doc["kv"].items()},
+    }
 
 
 # --------------------------- radix index -------------------------------

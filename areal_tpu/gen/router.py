@@ -76,6 +76,22 @@ class RouterConfig:
     health_check_interval: float = 5.0
     health_failure_threshold: int = 3
     health_probe_timeout: float = 2.0
+    # disaggregated prefill/decode serving (ISSUE 17): when enabled and
+    # both role pools are non-empty, /generate runs as two legs — leg 1
+    # (admission + prefill + the first sampled token) on a prefill-role
+    # server, then a /kv_export -> /kv_import page-set transfer, then
+    # leg 2 (the decode tail) on a decode-role server.  The counter-keyed
+    # sampler makes the merged stream bit-identical to colocated serving.
+    disagg: bool = False
+    # backpressure: at most this many export/import transfers in flight
+    # fleet-wide; excess requests fall back to colocated placement
+    handoff_max_inflight: int = 4
+    # tokens generated on the prefill server before the handoff (>= 1:
+    # prefill itself samples the first token)
+    handoff_leg1_tokens: int = 1
+    # decode-pool placement signal: poll decode servers' /metrics for
+    # tier occupancy at this cadence (0 falls back to in-flight counts)
+    occupancy_poll_interval: float = 1.0
 
 
 @lock_guarded
@@ -90,6 +106,8 @@ class Router:
         "_failovers": "_lock",
         "_publish_partial_failures": "_lock",
         "_last_publish": "_lock",
+        "_handoffs": "_lock",
+        "_handoff_fallbacks": "_lock",
     }
     # declared acquisition order (areal-lint C5): _flush_and_update holds
     # the flush serializer across the backend fan-out, then takes the
@@ -123,6 +141,17 @@ class Router:
         self._failovers = 0
         self._publish_partial_failures = 0
         self._last_publish: Optional[tuple] = None
+        # disaggregated serving (ISSUE 17): role advertised by each
+        # backend's /health ("prefill" | "decode" | "both"), the decode
+        # pool's polled tier occupancy, the transfer-backpressure
+        # semaphore, and the handoff ledger.  Roles/occupancy are only
+        # touched on the event loop, so they ride without the ledger lock.
+        self._roles: Dict[str, str] = {}
+        self._decode_occ: Dict[str, float] = {}
+        self._handoff_sem: Optional[asyncio.Semaphore] = None
+        self._occ_poller: Optional[asyncio.Task] = None
+        self._handoffs = 0
+        self._handoff_fallbacks = 0
 
     # ---------------------------- scheduling ----------------------------
 
@@ -165,6 +194,43 @@ class Router:
                 self._rid_to_addr.popitem(last=False)
             self._rid_to_addr[rid] = addr
         return addr
+
+    # ---------------- disaggregated placement (ISSUE 17) ----------------
+
+    def _role_pool(self, role: str) -> List[str]:
+        """Placeable backends advertising exactly `role`.  Servers running
+        `both` stay out of the role pools — they are the colocated
+        fallback capacity, not handoff endpoints."""
+        return [
+            a for a in self._placeable()
+            if self._roles.get(a, "both") == role
+        ]
+
+    def _prefill_for_rid(self, rid: str, pool: List[str]) -> str:
+        """Prefill-pool placement: group/rid affinity first (GRPO fan-out
+        must share cluster prefixes inside ONE prefill engine), else the
+        shallowest queue."""
+        if rid and self._rid_to_addr.get(rid) in pool:
+            addr = self._rid_to_addr[rid]
+            self._rid_to_addr.move_to_end(rid)
+            return addr
+        addr = min(pool, key=lambda a: self._inflight.get(a, 0))
+        if rid:
+            if len(self._rid_to_addr) >= RID_CACHE_SIZE:
+                self._rid_to_addr.popitem(last=False)
+            self._rid_to_addr[rid] = addr
+        return addr
+
+    def _decode_pick(self, pool: List[str]) -> str:
+        """Decode-pool placement: lowest polled tier occupancy (the
+        /metrics signal), in-flight count as the tiebreak/fallback."""
+        return min(
+            pool,
+            key=lambda a: (
+                self._decode_occ.get(a, 0.0),
+                self._inflight.get(a, 0),
+            ),
+        )
 
     def _evict_backend_locked(self, addr: str) -> int:  # holds: _lock
         """Drop every rid affinity pinned to `addr`; returns the count.
@@ -218,6 +284,12 @@ class Router:
         # the group when one is declared, the rid otherwise (interruption
         # resubmits keep riding the same key either way)
         rid = body.get("group_id") or body.get("rid", "")
+        if self.config.disagg:
+            resp = await self._generate_disagg(body, rid)
+            if resp is not None:
+                return resp
+            # fall through: colocated placement (empty role pool, breaker
+            # open, transfer backpressure, or a failed prefill leg)
         # _tokens tracks tokens currently resident on each backend (a proxy
         # for live KV usage, the reference's least_token_usage signal) — NOT
         # a cumulative history, so finished requests free their share
@@ -284,6 +356,174 @@ class Router:
         if self._health is not None and status == 200:
             await self._health.report_success(addr)
         return web.json_response(payload, status=status)
+
+    # ---------------- disaggregated handoff (ISSUE 17) ------------------
+
+    async def _leg_post(self, addr: str, path: str, body: dict,
+                        n_tokens: int):
+        """One backend POST with the same in-flight/token bookkeeping the
+        colocated path keeps; transport errors propagate to the caller."""
+        async with self._lock:
+            self._inflight[addr] = self._inflight.get(addr, 0) + 1
+            self._routed[addr] = self._routed.get(addr, 0) + 1
+            self._tokens[addr] = self._tokens.get(addr, 0) + n_tokens
+        try:
+            async with self._session.post(
+                f"http://{addr}{path}", json=body
+            ) as resp:
+                return resp.status, await resp.json()
+        finally:
+            async with self._lock:
+                self._inflight[addr] = max(0, self._inflight.get(addr, 1) - 1)
+                self._tokens[addr] = max(0, self._tokens.get(addr, 0) - n_tokens)
+
+    async def _generate_disagg(self, body: dict, rid: str):
+        """Two-leg disaggregated /generate: leg 1 (admission + prefill +
+        the first sampled token) on a prefill-role server, the page-set
+        transfer, then leg 2 (the decode tail) on a decode-role server.
+        Returns None to make the caller fall back to colocated placement
+        — which is always exact, because the counter-keyed sampler makes
+        the stream a pure function of (stream_id, position)."""
+        cfg = self.config
+        if body.get("pixel_values_b64"):
+            # VLM prefill still samples from the engine's rng stream, so
+            # a cross-server continuation is not reproducible — colocate
+            return None
+        sp = dict(body.get("sampling_params", {}) or {})
+        orig_max = int(sp.get("max_new_tokens", 256))
+        orig_min = int(sp.get("min_new_tokens", 0) or 0)
+        leg1_n = max(1, cfg.handoff_leg1_tokens)
+        async with self._lock:
+            prefill_pool = self._role_pool("prefill")
+            decode_pool = self._role_pool("decode")
+            if (
+                not prefill_pool
+                or not decode_pool
+                or orig_max <= leg1_n
+                or self._handoff_sem is None
+                or self._handoff_sem.locked()  # backpressure: at capacity
+            ):
+                return None
+            prefill_addr = self._prefill_for_rid(rid, prefill_pool)
+            decode_addr = self._decode_pick(decode_pool)
+
+        # --- leg 1 -----------------------------------------------------
+        leg1_body = dict(body)
+        leg1_sp = dict(sp)
+        leg1_sp["max_new_tokens"] = leg1_n
+        leg1_sp["min_new_tokens"] = min(orig_min, leg1_n)
+        leg1_body["sampling_params"] = leg1_sp
+        n_prompt = len(body.get("input_ids", ()))
+        try:
+            status, leg1 = await self._leg_post(
+                prefill_addr, "/generate", leg1_body, n_prompt
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            # the prefill leg died before any token was delivered, so
+            # nothing is lost: strike the breaker, drop the affinity, and
+            # let the caller place the whole request colocated
+            if self._health is not None:
+                await self._health.report_failure(prefill_addr, repr(e))
+            async with self._lock:
+                if self._rid_to_addr.get(rid) == prefill_addr:
+                    del self._rid_to_addr[rid]
+                    self._failovers += 1
+                self._handoff_fallbacks += 1
+            return None
+        if status != 200:
+            async with self._lock:
+                self._handoff_fallbacks += 1
+            return None
+        if self._health is not None:
+            await self._health.report_success(prefill_addr)
+        toks = [int(t) for t in leg1.get("output_tokens", [])]
+        if leg1.get("stop_reason") != "length" or not toks:
+            # finished inside leg 1 (eos / stop token): nothing to hand off
+            return web.json_response(leg1, status=200)
+
+        # --- page-set transfer -----------------------------------------
+        full_ids = [int(t) for t in body["input_ids"]] + toks
+        trace_id = str(body.get("trace_id", "") or "")
+        t0 = time.perf_counter()
+        moved = False
+        nbytes = 0
+        async with self._handoff_sem:
+            try:
+                async with self._session.post(
+                    f"http://{prefill_addr}/kv_export",
+                    json={"input_ids": full_ids},
+                ) as resp:
+                    doc = await resp.json() if resp.status == 200 else None
+                if doc is not None:
+                    nbytes = int(doc.get("nbytes", 0) or 0)
+                    async with self._session.post(
+                        f"http://{decode_addr}/kv_import", json=doc
+                    ) as iresp:
+                        moved = iresp.status == 200
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                moved = False
+        if moved:
+            telemetry.emit(
+                "handoff",
+                trace_id=trace_id or None,
+                latency_s=time.perf_counter() - t0,
+                bytes=nbytes,
+                src=prefill_addr,
+                dst=decode_addr,
+            )
+            async with self._lock:
+                self._handoffs += 1
+        else:
+            # transfer failed (cache miss, dead decode server, no host
+            # tier): continue the tail on the prefill server itself — a
+            # colocated continuation, exact under the counter-keyed stream
+            decode_addr = prefill_addr
+            async with self._lock:
+                self._handoff_fallbacks += 1
+
+        # --- leg 2 -----------------------------------------------------
+        leg2_body = dict(body)
+        leg2_sp = dict(sp)
+        leg2_sp["max_new_tokens"] = orig_max - len(toks)
+        leg2_sp["min_new_tokens"] = max(0, orig_min - len(toks))
+        leg2_body["sampling_params"] = leg2_sp
+        leg2_body["input_ids"] = full_ids
+        leg2_body["stream_id"] = int(leg1.get("stream_id", 0) or 0)
+        try:
+            status2, leg2 = await self._leg_post(
+                decode_addr, "/generate", leg2_body, len(full_ids)
+            )
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            if self._health is not None:
+                await self._health.report_failure(decode_addr, repr(e))
+            if decode_addr == prefill_addr:
+                return await self._proxy_failed(prefill_addr, rid, e)
+            # the decode server died mid-tail; the prefill server still
+            # retains the pages, so retry the tail there
+            try:
+                status2, leg2 = await self._leg_post(
+                    prefill_addr, "/generate", leg2_body, len(full_ids)
+                )
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e2:
+                return await self._proxy_failed(prefill_addr, rid, e2)
+        if status2 != 200:
+            return web.json_response(leg2, status=status2)
+        if self._health is not None:
+            await self._health.report_success(decode_addr)
+        merged = dict(leg2)
+        merged["output_tokens"] = toks + [
+            int(t) for t in leg2.get("output_tokens", [])
+        ]
+        merged["output_logprobs"] = list(
+            leg1.get("output_logprobs", [])
+        ) + list(leg2.get("output_logprobs", []))
+        merged["output_versions"] = list(
+            leg1.get("output_versions", [])
+        ) + list(leg2.get("output_versions", []))
+        # the admission that mattered for warm-start accounting is leg 1's
+        merged["cache_hit_tokens"] = leg1.get("cache_hit_tokens", 0)
+        merged["handoff"] = moved
+        return web.json_response(merged, status=200)
 
     async def _proxy_failed(
         self, addr: str, rid: str, exc: BaseException
@@ -437,6 +677,9 @@ class Router:
                 "n_flushes": self.n_flushes,
                 "failovers": self._failovers,
                 "publish_partial_failures": self._publish_partial_failures,
+                "handoffs": self._handoffs,
+                "handoff_fallbacks": self._handoff_fallbacks,
+                "roles": dict(self._roles),
             }
         snap["backend_states"] = (
             await self._health.snapshot() if self._health is not None else {}
@@ -478,6 +721,15 @@ class Router:
                 "areal_publish_partial_failures_total",
                 "fleet members missed by weight publishes",
             ).set_total(snap["publish_partial_failures"])
+            reg.counter(
+                "handoffs_total",
+                "completed prefill->decode KV handoffs",
+            ).set_total(snap["handoffs"])
+            reg.counter(
+                "handoff_fallbacks_total",
+                "disaggregated requests that fell back to colocated "
+                "placement (empty pool, backpressure, or transfer failure)",
+            ).set_total(snap["handoff_fallbacks"])
             state_gauge = reg.gauge(
                 "backend_state",
                 "circuit state per backend "
@@ -607,7 +859,46 @@ class Router:
             ),
         ) as resp:
             resp.raise_for_status()
-            return await resp.json()
+            health = await resp.json()
+        # role advertisement rides the health probe, so a restarted
+        # backend that changed roles is re-pooled within one probe sweep
+        if health.get("role"):
+            self._roles[addr] = str(health["role"])
+        return health
+
+    async def _poll_decode_occupancy(self):
+        """Decode-pool placement signal: poll decode-role backends'
+        /metrics for tier occupancy (occupied slots / total slots)."""
+        while True:
+            await asyncio.sleep(self.config.occupancy_poll_interval)
+            try:
+                targets = [
+                    a for a in self.addresses
+                    if self._roles.get(a) == "decode"
+                ]
+
+                async def probe(a: str) -> Optional[float]:
+                    try:
+                        async with self._session.get(
+                            f"http://{a}/metrics",
+                            timeout=aiohttp.ClientTimeout(total=2),
+                        ) as resp:
+                            m = await resp.json()
+                        occ = m.get("tier_occupancy") or []
+                        slots = m.get("tier_slots") or []
+                        total = sum(slots)
+                        if not total:
+                            return None
+                        return float(sum(occ)) / float(total)
+                    except Exception:  # noqa: BLE001 — unreachable = no info
+                        return None
+
+                vals = await asyncio.gather(*[probe(a) for a in targets])
+                for a, v in zip(targets, vals):
+                    if v is not None:
+                        self._decode_occ[a] = v
+            except Exception:  # noqa: BLE001 — poller must survive blips
+                logger.exception("decode occupancy poll failed")
 
     async def _verify_rejoin(self, addr: str, health: dict) -> bool:
         """Gate for half_open -> closed: a backend that answered after being
@@ -724,6 +1015,25 @@ class Router:
             verify_rejoin=self._verify_rejoin,
         )
         self._health.start()
+        if self.config.disagg:
+            self._handoff_sem = asyncio.Semaphore(
+                max(1, self.config.handoff_max_inflight)
+            )
+            # prime the role map so the first /generate can already place
+            # disaggregated (the health probes keep it fresh afterwards)
+            for a in self.addresses:
+                try:
+                    await self._probe_backend(a)
+                except Exception:  # noqa: BLE001 — backend not up yet
+                    continue
+            if self.config.occupancy_poll_interval > 0:
+                self._occ_poller = asyncio.create_task(
+                    self._poll_decode_occupancy()
+                )
+            logger.info(
+                "disaggregated serving on: roles="
+                + str({a: self._roles.get(a, '?') for a in self.addresses})
+            )
         if self.config.weights_path and self.config.experiment_name:
             self._watcher = asyncio.create_task(self._watch_checkpoints())
         elif (
@@ -754,6 +1064,8 @@ class Router:
             self._watcher.cancel()
         if self._version_poller is not None:
             self._version_poller.cancel()
+        if self._occ_poller is not None:
+            self._occ_poller.cancel()
         if self._health is not None:
             await self._health.stop()
         if self._session is not None:
@@ -790,6 +1102,11 @@ def main():
     p.add_argument("--train-batch-size", type=int, default=0)
     p.add_argument("--max-head-offpolicyness", type=int, default=0)
     p.add_argument("--weights-path", default="")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated prefill/decode serving: run "
+                        "/generate as a prefill leg + KV handoff + decode "
+                        "leg when both role pools are populated")
+    p.add_argument("--handoff-max-inflight", type=int, default=4)
     args = p.parse_args()
     cfg = RouterConfig(
         experiment_name=args.experiment_name,
@@ -798,6 +1115,8 @@ def main():
         train_batch_size=args.train_batch_size,
         max_head_offpolicyness=args.max_head_offpolicyness,
         weights_path=args.weights_path,
+        disagg=args.disagg,
+        handoff_max_inflight=args.handoff_max_inflight,
     )
     router = Router(cfg, addresses=args.addrs.split(",") if args.addrs else None)
     port = args.port or network.find_free_port()

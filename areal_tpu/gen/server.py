@@ -43,13 +43,25 @@ logger = logging.getLogger("gen.server")
 
 @lock_guarded
 class GenServer:
-    # the weight-update mailbox is handed between asyncio handlers and the
-    # device-worker thread; every touch must hold _cmd_lock (areal-lint C1,
-    # runtime-validated under AREAL_DEBUG_LOCKS=1)
-    _GUARDED_FIELDS = {"_pending_weight_update": "_cmd_lock"}
+    # the weight-update and handoff mailboxes are handed between asyncio
+    # handlers and the device-worker thread; every touch must hold
+    # _cmd_lock (areal-lint C1, runtime-validated under
+    # AREAL_DEBUG_LOCKS=1)
+    _GUARDED_FIELDS = {
+        "_pending_weight_update": "_cmd_lock",
+        "_pending_handoffs": "_cmd_lock",
+    }
 
-    def __init__(self, engine: GenEngine):
+    def __init__(self, engine: GenEngine, role: str = "both"):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown server role: {role!r}")
         self.engine = engine
+        # Disaggregated serving (ISSUE 17): the role is a routing
+        # *advertisement* — the engine itself stays fully capable either
+        # way (export/import/generate all work on any role), so a router
+        # can always fall back to colocated `both` semantics when a role
+        # pool empties or a breaker opens.
+        self.role = role
         self.paused = threading.Event()  # set => paused
         self.shutdown = threading.Event()
         self._weight_futures: "list" = []
@@ -58,6 +70,11 @@ class GenServer:
         self._last_committed_version: Optional[int] = None
         self._cmd_lock = threading.Lock()
         self._pending_weight_update: Optional[dict] = None
+        # KV-handoff mailbox: /kv_export and /kv_import enqueue here and
+        # the worker thread services the engine calls (which touch the
+        # device cache) between decode steps — even while paused, so a
+        # weight-update window never deadlocks an in-flight handoff.
+        self._pending_handoffs: "list" = []
         self.worker = threading.Thread(target=self._run, daemon=True)
         self.step_count = 0
         self.tokens_out = 0
@@ -176,6 +193,7 @@ class GenServer:
                 except Exception as e:  # noqa: BLE001 — surface to the caller
                     upd["future"].set_exception(e)
                 continue
+            self._service_handoffs()
             if self.paused.is_set():
                 time.sleep(0.005)
                 continue
@@ -190,6 +208,43 @@ class GenServer:
             self.tokens_out += stepped
             if not stepped:
                 time.sleep(0.002)
+
+    def _service_handoffs(self):
+        """Drain the KV-handoff mailbox on the worker thread.  The
+        engine's export/import methods gather/scatter against the device
+        cache, so they must run where every other device touch runs —
+        here, between decode steps — never on an HTTP handler thread."""
+        ops = None
+        with self._cmd_lock:
+            if self._pending_handoffs:
+                ops = self._pending_handoffs
+                self._pending_handoffs = []
+        if not ops:
+            return
+        for op in ops:
+            t0 = time.perf_counter()
+            try:
+                if op["kind"] == "export":
+                    op["future"].set_result(
+                        self.engine.export_request_kv(op["input_ids"])
+                    )
+                else:
+                    op["future"].set_result(
+                        self.engine.import_request_kv(op["entry"])
+                    )
+                telemetry.HANDOFF.observe(
+                    time.perf_counter() - t0, op=op["kind"]
+                )
+            except Exception as e:  # noqa: BLE001 — surface to the caller
+                op["future"].set_exception(e)
+
+    def _queue_handoff(self, **kw):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        with self._cmd_lock:
+            self._pending_handoffs.append({"future": fut, **kw})
+        return fut
 
     # ----------------------------- handlers -----------------------------
 
@@ -219,6 +274,10 @@ class GenServer:
             stop_token_ids=[int(t) for t in sp.get("stop_token_ids", [])],
             pixel_values=pixel_values,
             image_grid_thw=image_grid_thw,
+            # disaggregated handoff (ISSUE 17): leg-2 resubmissions pin
+            # the sampler stream so the continuation samples the exact
+            # keys the colocated run would have used
+            stream_id=int(body.get("stream_id", 0) or 0),
             on_done=on_done,
         )
 
@@ -235,6 +294,10 @@ class GenServer:
             # host swap-in) — failover clients use this to confirm a
             # resubmission warm-started instead of cold-prefilling
             "cache_hit_tokens": r.cache_hit_tokens,
+            # the counter-keyed sampler stream this request decoded on;
+            # a handoff leg-2 (or failover resubmit) passes it back in
+            # so the continuation stays bit-identical
+            "stream_id": r.stream_id,
         }
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -277,6 +340,71 @@ class GenServer:
         version = self.engine.version
         return web.json_response(
             {"results": [self._result_payload(r, version) for r in done]}
+        )
+
+    # ------------------------- KV handoff (ISSUE 17) --------------------
+
+    _HANDOFF_TIMEOUT_S = 30.0
+
+    async def kv_export(self, request: web.Request) -> web.Response:
+        """Serialize the retained KV pages covering a prefix of
+        `input_ids` to the wire format (gather on the existing bucket
+        ladder -> host -> base64).  404 when neither the device radix nor
+        the host tier retains a usable prefix — the router then falls
+        back to a cold leg-2 prefill, which the counter-keyed sampler
+        keeps bit-identical anyway."""
+        from areal_tpu.gen import kv_pool
+
+        body = await request.json()
+        fut = self._queue_handoff(
+            kind="export",
+            input_ids=[int(t) for t in body["input_ids"]],
+        )
+        try:
+            entry = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self._HANDOFF_TIMEOUT_S
+            )
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": "kv_export timed out"}, status=503
+            )
+        if entry is None:
+            return web.json_response(
+                {"error": "no exportable prefix"}, status=404
+            )
+        doc = kv_pool.wire_encode_entry(entry)
+        return web.json_response(doc)
+
+    async def kv_import(self, request: web.Request) -> web.Response:
+        """Install a wire-format KV entry into the host overflow tier;
+        the next admission matching its token prefix swaps it in as a
+        warm-cache hit (the same path a local spill round trip takes)."""
+        from areal_tpu.gen import kv_pool
+
+        body = await request.json()
+        try:
+            entry = kv_pool.wire_decode_entry(body)
+        except (KeyError, ValueError) as e:
+            return web.json_response(
+                {"error": f"malformed wire entry: {e}"}, status=400
+            )
+        fut = self._queue_handoff(kind="import", entry=entry)
+        try:
+            ok = await asyncio.wait_for(
+                asyncio.wrap_future(fut), timeout=self._HANDOFF_TIMEOUT_S
+            )
+        except asyncio.TimeoutError:
+            return web.json_response(
+                {"error": "kv_import timed out"}, status=503
+            )
+        if not ok:
+            return web.json_response(
+                {"error": "no host tier on this server "
+                          "(start with --host-offload)"},
+                status=409,
+            )
+        return web.json_response(
+            {"ok": True, "valid_len": int(entry["valid_len"])}
         )
 
     async def pause(self, request: web.Request) -> web.Response:
@@ -446,6 +574,7 @@ class GenServer:
         return web.json_response(
             {
                 "status": "paused" if self.paused.is_set() else "ok",
+                "role": self.role,
                 "version": self.engine.version,
                 "active": self.engine.active_count(),
                 "last_error": self.last_error,
@@ -471,6 +600,7 @@ class GenServer:
                 "decode_steps": self.step_count,
                 "tokens_generated": self.tokens_out,
                 "active": self.engine.active_count(),
+                "role": self.role,
                 "version": self.engine.version,
                 # achieved generation-idle window of the last weight swap
                 "last_pause_s": round(self.engine.last_pause_s, 4),
@@ -524,6 +654,16 @@ class GenServer:
                 "prefix_cache_hit_rate": round(
                     self.engine.prefix_cache_hit_rate(), 4
                 ),
+                "prefix_cache_partial_hits": stats.get(
+                    "prefix_cache_partial_hits", 0
+                ),
+                # disaggregated prefill/decode handoff (ISSUE 17): the
+                # router's decode-pool placement reads tier_occupancy
+                # above; these counters are the transfer ledger
+                "kv_handoff_exports": stats.get("kv_handoff_exports", 0),
+                "kv_handoff_imports": stats.get("kv_handoff_imports", 0),
+                "kv_handoff_bytes": stats.get("kv_handoff_bytes", 0),
+                "kv_handoff_failures": stats.get("kv_handoff_failures", 0),
             }
         )
 
@@ -537,6 +677,8 @@ class GenServer:
         app.router.add_post("/continue_generation", self.resume)
         app.router.add_post("/update_weights_from_disk", self.update_weights_from_disk)
         app.router.add_post("/update_weights_chunk", self.update_weights_chunk)
+        app.router.add_post("/kv_export", self.kv_export)
+        app.router.add_post("/kv_import", self.kv_import)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
         return app
@@ -551,11 +693,12 @@ def serve(
     experiment_name: str = "",
     trial_name: str = "",
     server_idx: int = 0,
+    role: str = "both",
 ):
     """Blocking serve; registers the address in name_resolve for discovery
     (reference: sglang_server.py registration)."""
     port = port or network.find_free_port()
-    server = GenServer(engine)
+    server = GenServer(engine, role=role)
     server.start()
     if experiment_name:
         name_resolve.add(
@@ -609,6 +752,14 @@ def main():
     p.add_argument("--spec-draft-len", type=int, default=0,
                    help="pin the draft length instead of adapting along "
                         "the ladder (benches/tests)")
+    p.add_argument("--role", choices=("prefill", "decode", "both"),
+                   default="both",
+                   help="disaggregated-fleet role advertised to the "
+                        "router: prefill servers take admissions and "
+                        "export KV via /kv_export, decode servers import "
+                        "via /kv_import and continue the stream; `both` "
+                        "is the colocated default and the router's "
+                        "fallback when a role pool is empty")
     p.add_argument("--host-offload", action="store_true",
                    help="spill evicted retained prefixes to a host-DRAM "
                         "LRU tier and swap them back on radix hits")
@@ -621,6 +772,11 @@ def main():
     args = p.parse_args()
     if args.telemetry:
         telemetry.set_enabled(True)
+    if args.role == "decode" and not args.host_offload:
+        # a decode-role server receives its work as /kv_import host-tier
+        # entries; without the tier every import would 409
+        logger.info("--role decode implies --host-offload; enabling it")
+        args.host_offload = True
     tier_kw = dict(
         decode_window=not args.no_decode_window,
         decode_tiers=args.decode_tiers,
@@ -662,6 +818,7 @@ def main():
         experiment_name=args.experiment_name,
         trial_name=args.trial_name,
         server_idx=args.server_idx,
+        role=args.role,
     )
 
 

@@ -134,6 +134,7 @@ def build_report(source: trace_mod.EventSource, *, run_id: str = "",
             },
         },
         "prefill": _prefill_summary(rep),
+        "handoff": _handoff_summary(rep, closed),
     }
     return report
 
@@ -152,6 +153,21 @@ def _prefill_summary(rep: TraceReport) -> Dict[str, Any]:
         "cold_tokens": cold,
         "inherited_tokens": inherited,
         "shared_fraction": (inherited / total) if total else None,
+    }
+
+
+def _handoff_summary(rep: TraceReport, closed) -> Dict[str, Any]:
+    """Disaggregated prefill->decode handoff ledger (ISSUE 17): transfer
+    counts/bytes from the `handoff` events plus the per-trajectory
+    handoff-stage latency (the same samples the `stages.handoff` band in
+    check_slo gates on)."""
+    n = sum(r.handoffs for r in rep.records)
+    return {
+        "n": n,
+        "trajectories": sum(1 for r in rep.records if r.handoffs),
+        "bytes": sum(r.handoff_bytes for r in rep.records),
+        "latency_s": dist_summary(
+            r.stages["handoff"] for r in closed if "handoff" in r.stages),
     }
 
 
@@ -218,7 +234,16 @@ def render_markdown(report: Dict[str, Any]) -> str:
     pause_line = f"- pause windows: n={pa.get('n', 0)}"
     if pa.get("dur_s"):
         pause_line += f" p99={_fmt_s(pa['dur_s']['p99'])}"
-    lines += ["", st_line, pause_line, ""]
+    ho = report.get("handoff") or {}
+    if ho.get("n"):
+        ho_line = (f"- kv handoffs: {ho['n']} over "
+                   f"{ho.get('trajectories', 0)} trajectories, "
+                   f"{ho.get('bytes', 0)} bytes")
+        if ho.get("latency_s"):
+            ho_line += f", p99={_fmt_s(ho['latency_s']['p99'])}"
+    else:
+        ho_line = "- kv handoffs: none"
+    lines += ["", st_line, pause_line, ho_line, ""]
     return "\n".join(lines)
 
 

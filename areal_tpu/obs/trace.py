@@ -54,7 +54,7 @@ _TERMINAL_EVENTS = ("gen_done", "rollout_lost")
 # a trace with no root means the head of the log was lost.
 _REQUIRES_ROOT = (
     "prefill", "resume", "resubmit", "interrupt", "reward",
-    "gen_done", "rollout_lost",
+    "gen_done", "rollout_lost", "handoff",
 )
 # Global (traceless) events: never orphan candidates.  run_restart marks
 # a trainer relaunch resuming from a recover generation (utils/recover.py)
@@ -124,13 +124,16 @@ class TrajectoryRecord:
     attempts: int = 1
     resubmits: int = 0
     interrupts: int = 0
+    handoffs: int = 0
+    handoff_bytes: int = 0
     closed: bool = False
     lost: bool = False
     has_submit: bool = False
     has_admission: bool = False
     clock: str = "mono"            # which clock built the stage partition
     # Stage partition of [root, terminal] in seconds.  Keys among:
-    # admission_wait / prefill / decode / interrupted / tail / opaque.
+    # admission_wait / prefill / decode / handoff / interrupted / tail
+    # / opaque.
     stages: Dict[str, float] = dataclasses.field(default_factory=dict)
     span_s: Optional[float] = None       # terminal - root, event clocks
     e2e_s: Optional[float] = None        # gen_done.latency_s (client)
@@ -264,6 +267,20 @@ def _build_record(trace_id: str, events: List[Dict[str, Any]]) -> TrajectoryReco
             if first_chunk_end is None:
                 first_chunk_end = t
             last_chunk_end = t
+        elif name == "handoff":
+            # Disaggregated prefill->decode transfer (ISSUE 17): the
+            # router measures the full export+import leg and stamps it
+            # as latency_s; everything before the leg stays in the
+            # prior stage (decode chunks served on the prefill server),
+            # and the leg itself becomes its own stage so SLO reports
+            # can band it.
+            lat = float(e.get("latency_s", 0.0) or 0.0)
+            start = max(cursor, t - lat)
+            close(start, state)
+            close(t, "handoff")
+            state = "handoff"
+            rec.handoffs += 1
+            rec.handoff_bytes += int(e.get("bytes", 0) or 0)
         elif name == "interrupt":
             close(t, state)
             state = "interrupted"

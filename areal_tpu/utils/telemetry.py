@@ -357,6 +357,11 @@ DECODE_CHUNK = GEN.histogram(
     "decode_chunk_seconds",
     "Per-tier decode-chunk dispatch+fetch latency (label: tier)",
 )
+HANDOFF = GEN.histogram(
+    "kv_handoff_seconds",
+    "Prefill->decode KV handoff latency (label: op=export|import) — the "
+    "worker-thread service time of one cross-server page-set transfer leg",
+)
 STALENESS_AT_CONSUMPTION = TRAIN.histogram(
     "staleness_at_consumption",
     "consumed_version - behavior_version per trajectory row at train_batch",

@@ -58,7 +58,8 @@ SCHEMA = "areal-replay-curves/v1"
 # ---------------------------------------------------------------------------
 
 
-def _boot_server(cfg, params, args):
+def _boot_server(cfg, params, args, role: str = "both",
+                 host_offload: Optional[bool] = None):
     """One GenServer on its own aiohttp thread (the bench_e2e pattern:
     two OS processes cannot share a chip, so the fleet slice lives in
     threads).  Returns (addr, stop)."""
@@ -78,11 +79,12 @@ def _boot_server(cfg, params, args):
         prompt_bucket=64,
         decode_chunk=8,
         share_prefix=True,
-        host_offload=args.host_offload,
+        host_offload=(args.host_offload
+                      if host_offload is None else host_offload),
         host_cache_mb=args.host_cache_mb,
         host_min_tokens=args.host_min_tokens,
     )
-    server = GenServer(engine)
+    server = GenServer(engine, role=role)
     server.start()
     port = network.find_free_port()
     loop = asyncio.new_event_loop()
@@ -106,7 +108,7 @@ def _boot_server(cfg, params, args):
     return f"127.0.0.1:{port}", stop
 
 
-def _boot_router(addrs: List[str]):
+def _boot_router(addrs: List[str], disagg: bool = False):
     """The real Router over the booted servers, same thread pattern."""
     import threading
 
@@ -114,7 +116,7 @@ def _boot_router(addrs: List[str]):
 
     from areal_tpu.gen.router import Router, RouterConfig
 
-    router = Router(RouterConfig(), addresses=list(addrs))
+    router = Router(RouterConfig(disagg=disagg), addresses=list(addrs))
     state: Dict[str, Any] = {}
     started = threading.Event()
 
@@ -170,12 +172,26 @@ def _wait_health(addr: str, timeout: float = 60.0) -> None:
 
 async def _drive(addr: str, arrivals: List[wl.Arrival], *, rate: float,
                  vocab: int, seed: int, timeout: float,
-                 max_seq_len: int) -> List[Dict[str, Any]]:
+                 max_seq_len: int, pin_streams: bool = False,
+                 record: bool = False,
+                 retries: int = 0) -> List[Dict[str, Any]]:
     """Replay one rate multiplier: fire every arrival at its scheduled
     time (absolute offsets from the run start, so client-side queueing
     delay shows up as latency, exactly like an open-loop load test) and
-    measure per-request wall latency."""
+    measure per-request wall latency.
+
+    ``pin_streams`` assigns a deterministic sampler stream id per
+    trace_id (the cross-fleet bit-identity contract: same-seed engines
+    share ``_decode_key``, so a client-pinned stream makes the token
+    stream a pure function of the request, not of which server — or
+    fleet topology — served it).  ``record`` keeps trace_id + token +
+    logprob streams on each result for A/B comparison.  ``retries``
+    emulates the RemoteInfEngine failover contract: on transport error
+    resubmit up to N times (counter-keyed sampling makes the resubmit
+    continue the identical stream), and only exhausted retries count as
+    lost trajectories."""
     import aiohttp
+    import zlib
 
     scaled = wl.scale(arrivals, rate)
     results: List[Dict[str, Any]] = []
@@ -204,29 +220,49 @@ async def _drive(addr: str, arrivals: List[wl.Arrival], *, rate: float,
                     "temperature": 1.0,
                 },
             }
+            if pin_streams:
+                payload["stream_id"] = (
+                    (zlib.crc32(trace_id.encode()) & 0x0FFFFFFF) + 1)
             telemetry.emit("rollout_submit", trace_id=trace_id,
                            rid=trace_id, group_id=payload["group_id"],
                            input_len=len(ids), server=addr)
             start = time.perf_counter()
             rec: Dict[str, Any] = {"kind": a.kind, "rate": rate}
-            try:
-                async with session.post(
-                        f"http://{addr}/generate", json=payload) as resp:
-                    body = await resp.json()
-                    if resp.status != 200:
-                        raise RuntimeError(f"HTTP {resp.status}")
-                lat = time.perf_counter() - start
-                out_len = len(body.get("output_tokens", []))
-                telemetry.emit("gen_done", trace_id=trace_id,
-                               stop_reason=body.get("stop_reason", "stop"),
-                               output_len=out_len, attempts=1, latency_s=lat)
-                rec.update(ok=True, latency_s=lat, output_len=out_len,
-                           stop_reason=body.get("stop_reason", "stop"))
-            except Exception as e:  # noqa: BLE001 — errors are data here
-                lat = time.perf_counter() - start
-                telemetry.emit("rollout_lost", trace_id=trace_id)
-                rec.update(ok=False, latency_s=lat, output_len=0,
-                           error=str(e)[:120])
+            attempts = 0
+            while True:
+                attempts += 1
+                try:
+                    async with session.post(
+                            f"http://{addr}/generate", json=payload) as resp:
+                        body = await resp.json()
+                        if resp.status != 200:
+                            raise RuntimeError(f"HTTP {resp.status}")
+                    lat = time.perf_counter() - start
+                    out_len = len(body.get("output_tokens", []))
+                    telemetry.emit(
+                        "gen_done", trace_id=trace_id,
+                        stop_reason=body.get("stop_reason", "stop"),
+                        output_len=out_len, attempts=attempts, latency_s=lat)
+                    rec.update(ok=True, latency_s=lat, output_len=out_len,
+                               stop_reason=body.get("stop_reason", "stop"))
+                    if record:
+                        rec.update(
+                            trace_id=trace_id,
+                            tokens=list(body.get("output_tokens", [])),
+                            logprobs=list(body.get("output_logprobs", [])
+                                          or []))
+                    break
+                except Exception as e:  # noqa: BLE001 — errors are data here
+                    if attempts <= retries:
+                        telemetry.emit("resubmit", trace_id=trace_id,
+                                       attempt=attempts)
+                        await asyncio.sleep(0.2)
+                        continue
+                    lat = time.perf_counter() - start
+                    telemetry.emit("rollout_lost", trace_id=trace_id)
+                    rec.update(ok=False, latency_s=lat, output_len=0,
+                               error=str(e)[:120])
+                    break
             results.append(rec)
 
         await asyncio.gather(*(one(i, a) for i, a in enumerate(scaled)))
@@ -321,6 +357,249 @@ def _rate_summary(rate: float, arrivals: List[wl.Arrival],
     }
 
 
+# ---------------------------------------------------------------------------
+# disaggregated A/B (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+async def _warm_through_router(addr: str, *, vocab: int, n: int = 6) -> None:
+    """Through-router warmup: the direct per-server pass compiles the
+    fresh-prefill/decode programs, but only a routed request exercises
+    the disagg handoff path (leg1 clip, /kv_export, /kv_import, leg2
+    suffix-prefill on the decode server).  Run the same pass in BOTH
+    phases so the colocated control pays identical compile costs."""
+    import aiohttp
+
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300)) as session:
+        for i in range(n):
+            plen = 12 + 7 * i
+            payload = {
+                "rid": f"routewarm-{i}",
+                "trace_id": f"routewarm-{i}",
+                "input_ids": [3 + (j % max(1, vocab - 4))
+                              for j in range(plen)],
+                "sampling_params": {"max_new_tokens": 6,
+                                    "temperature": 1.0},
+            }
+            async with session.post(
+                    f"http://{addr}/generate", json=payload) as resp:
+                await resp.json()
+
+
+def _router_snap(addr: str) -> Dict[str, Any]:
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=5) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — metrics are best-effort evidence
+        return {}
+
+
+def _run_ab(args, p, arrivals: List[wl.Arrival],
+            rates: List[float], source: Dict[str, Any]) -> int:
+    """Disaggregated-vs-colocated A/B at matched arrival rate.
+
+    Two sequential phases over the SAME workload, seed, and total server
+    count: a colocated control (N role=both replicas) and the disagg
+    fleet (1 prefill + N-1 decode servers, role-aware router).  Client
+    pins sampler stream ids per trace_id, so the two phases must produce
+    bit-identical token streams — the exactness gate.  The perf verdict
+    is decode-interference elimination: disagg inter-token p99 must not
+    exceed the colocated control's.  ``--chaos`` kills the prefill
+    server mid-way through the last disagg rate; the driver's failover
+    retries (the RemoteInfEngine contract) must recover every
+    trajectory for the zero-lost gate."""
+    import threading
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import bench_serving as bs
+
+    cfg, params = bs.serving_model_setup(args.model)
+    vocab = cfg.vocab_size
+    n_servers = max(3, args.servers)
+    phases: Dict[str, Any] = {}
+    streams: Dict[str, Dict[str, Dict[str, Any]]] = {}
+
+    for mode in ("colocated", "disagg"):
+        stops: List[Any] = []
+        chaos_timer: Optional[threading.Timer] = None
+        try:
+            server_addrs = []
+            if mode == "colocated":
+                specs = [("both", None)] * n_servers
+            else:
+                # decode servers need the host tier: /kv_import installs
+                # pages as host-tier entries that swap-in re-scatters
+                specs = [("prefill", None)] + \
+                    [("decode", True)] * (n_servers - 1)
+            for role, off in specs:
+                a, stop = _boot_server(cfg, params, args, role=role,
+                                       host_offload=off)
+                server_addrs.append(a)
+                stops.append(stop)
+            addr, rstop = _boot_router(server_addrs,
+                                       disagg=(mode == "disagg"))
+            stops.append(rstop)
+            print(f"[{mode}] fleet up: {specs} -> {addr}",
+                  file=sys.stderr, flush=True)
+
+            asyncio.run(_warmup(server_addrs, vocab=vocab,
+                                max_seq_len=args.max_seq_len))
+            asyncio.run(_warm_through_router(addr, vocab=vocab))
+
+            telemetry.set_enabled(True)
+            telemetry.EVENTS.clear()
+            curve = []
+            phase_streams: Dict[str, Dict[str, Any]] = {}
+            for ri, rate in enumerate(rates):
+                last = ri == len(rates) - 1
+                chaos_here = args.chaos and mode == "disagg" and last
+                retries = 2 if chaos_here else 0
+                if chaos_here:
+                    span = (arrivals[-1].t / rate) if arrivals else 1.0
+                    kill_stop = stops[0]  # the prefill server
+                    chaos_timer = threading.Timer(
+                        max(0.2, 0.4 * span), kill_stop)
+                    chaos_timer.start()
+                    print(f"[{mode}] chaos: prefill kill armed at "
+                          f"{max(0.2, 0.4 * span):.1f}s into x{rate:g}",
+                          file=sys.stderr, flush=True)
+                t0 = time.perf_counter()
+                results = asyncio.run(_drive(
+                    addr, arrivals, rate=rate, vocab=vocab,
+                    seed=args.seed, timeout=args.timeout,
+                    max_seq_len=args.max_seq_len, pin_streams=True,
+                    record=True, retries=retries))
+                wall = time.perf_counter() - t0
+                for r in results:
+                    if r.get("ok") and "trace_id" in r:
+                        phase_streams[r["trace_id"]] = {
+                            "tokens": r.pop("tokens"),
+                            "logprobs": r.pop("logprobs"),
+                        }
+                summary = _rate_summary(rate, arrivals, results, wall)
+                summary["chaos"] = bool(chaos_here)
+                curve.append(summary)
+                lat = summary["latency_s"] or {}
+                print(f"[{mode}] rate x{rate:g}: "
+                      f"ok={summary['ok']}/{summary['n']} "
+                      f"p50={lat.get('p50')} p99={lat.get('p99')}",
+                      file=sys.stderr, flush=True)
+            router_snap = _router_snap(addr)
+
+            events_path = ""
+            slo_report: Dict[str, Any] = {}
+            if args.telemetry_dir:
+                events_path = os.path.join(
+                    args.telemetry_dir, f"events_{mode}.jsonl")
+                telemetry.EVENTS.dump_jsonl(events_path)
+                slo_report = slo_mod.build_report(
+                    events_path, run_id=f"replay-{mode}",
+                    source_name=events_path)
+            telemetry.set_enabled(False)
+            telemetry.EVENTS.clear()
+            phases[mode] = {
+                "curve": curve,
+                "router": {k: router_snap.get(k) for k in
+                           ("handoffs", "handoff_fallbacks", "roles",
+                            "failovers")},
+                "events_jsonl": events_path,
+                "slo": {k: slo_report.get(k) for k in
+                        ("inter_token_s", "ttft_s", "e2e_s",
+                         "handoff", "trajectories")} if slo_report else {},
+            }
+            streams[mode] = phase_streams
+            if slo_report and mode == "disagg" and args.slo_report:
+                with open(args.slo_report, "w") as f:
+                    json.dump(slo_report, f, indent=2)
+                    f.write("\n")
+                md = os.path.splitext(args.slo_report)[0] + ".md"
+                with open(md, "w") as f:
+                    f.write(slo_mod.render_markdown(slo_report))
+        finally:
+            if chaos_timer is not None:
+                chaos_timer.cancel()
+            for stop in reversed(stops):
+                try:
+                    stop()
+                except Exception as e:  # noqa: BLE001 — teardown only
+                    print(f"teardown: {str(e)[:120]}", file=sys.stderr)
+
+    # exactness: same trace_id => same pinned stream => identical tokens
+    # regardless of fleet topology (counter-keyed sampler; logprob
+    # mismatches are reported but informational — decode-vs-suffix XLA
+    # programs may differ in the last ulp at the handoff boundary)
+    common = sorted(set(streams["colocated"]) & set(streams["disagg"]))
+    token_mism = [t for t in common
+                  if streams["colocated"][t]["tokens"]
+                  != streams["disagg"][t]["tokens"]]
+    lp_mism = [t for t in common
+               if streams["colocated"][t]["logprobs"]
+               != streams["disagg"][t]["logprobs"]]
+    bit_identity = {
+        "compared": len(common),
+        "token_mismatches": len(token_mism),
+        "token_mismatch_ids": token_mism[:8],
+        "logprob_mismatches": len(lp_mism),
+    }
+
+    def _it_p99(mode: str) -> Optional[float]:
+        d = (phases[mode]["slo"] or {}).get("inter_token_s") or {}
+        return d.get("p99")
+
+    co_p99, dis_p99 = _it_p99("colocated"), _it_p99("disagg")
+    interference = {
+        "colocated_inter_token_p99": co_p99,
+        "disagg_inter_token_p99": dis_p99,
+        "win": (co_p99 is not None and dis_p99 is not None
+                and dis_p99 <= co_p99),
+    }
+    disagg_errors = sum(s["errors"] for s in phases["disagg"]["curve"])
+    gates = {
+        "bit_identity": len(common) > 0 and not token_mism,
+        "handoffs_nonzero":
+            int(phases["disagg"]["router"].get("handoffs") or 0) > 0,
+        "zero_lost": disagg_errors == 0,
+    }
+
+    out: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "mode": "disagg_ab",
+        "source": source,
+        "fleet": {"model": args.model, "servers": n_servers,
+                  "n_slots": args.n_slots,
+                  "max_seq_len": args.max_seq_len,
+                  "chaos": bool(args.chaos),
+                  "device_kind": jax.devices()[0].device_kind},
+        "workload": wl.summarize(arrivals),
+        "phases": phases,
+        "bit_identity": bit_identity,
+        "interference": interference,
+        "gates": gates,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    print(json.dumps(out))
+    failed = [k for k, v in gates.items() if not v]
+    if failed:
+        print(f"FAIL: disagg gates violated: {failed}", file=sys.stderr)
+        return 1
+    print(f"disagg A/B ok: {bit_identity['compared']} streams "
+          f"bit-identical, handoffs="
+          f"{phases['disagg']['router'].get('handoffs')}, "
+          f"inter-token p99 {dis_p99} vs colocated {co_p99}",
+          file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="tiny",
@@ -354,6 +633,14 @@ def main() -> int:
                    help="minimum retained length worth spilling to host")
     p.add_argument("--max-new-tokens", type=int, default=16,
                    help="synthetic workload decode-budget ceiling")
+    p.add_argument("--disagg", action="store_true",
+                   help="disaggregated A/B (ISSUE 17): colocated control "
+                        "vs 1-prefill + N-1-decode fleet over the same "
+                        "workload, gated on stream bit-identity")
+    p.add_argument("--chaos", action="store_true",
+                   help="with --disagg: kill the prefill server mid-way "
+                        "through the last rate; zero lost trajectories "
+                        "required (driver retries emulate client failover)")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the pre-measurement compile warmup")
     p.add_argument("--timeout", type=float, default=300.0,
@@ -392,6 +679,13 @@ def main() -> int:
         source = {"synthetic": args.workload, "seed": args.seed,
                   "duration_s": args.duration, "base_rps": args.base_rps}
     print(f"workload: {wl.summarize(arrivals)}", file=sys.stderr, flush=True)
+
+    if args.chaos and not args.disagg:
+        p.error("--chaos requires --disagg")
+    if args.disagg:
+        if args.addr:
+            p.error("--disagg self-hosts both fleets; drop --addr")
+        return _run_ab(args, p, arrivals, rates, source)
 
     # fleet ------------------------------------------------------------
     stops = []

@@ -38,6 +38,8 @@ class FakeGenServer:
         eos_token: Optional[int] = None,
         port: int = 0,
         fault_plan: Optional[FaultPlan] = None,
+        role: str = "both",
+        shutdown_grace: float = 0.5,
     ):
         self.completion = completion if completion is not None else list(range(100, 108))
         self.chunk_size = chunk_size
@@ -47,6 +49,17 @@ class FakeGenServer:
         self.paused = False
         self.abort_once = False
         self.delay_s = 0.0  # holds /generate in flight (load-balancing tests)
+        # how long stop() lets in-flight handlers finish; chaos tests set
+        # it below delay_s so a kill provably aborts the active request
+        self.shutdown_grace = shutdown_grace
+        # disaggregated serving (ISSUE 17): role advertised on /health,
+        # /kv_export + /kv_import record the handoff protocol, and the
+        # /metrics tier fields feed the router's decode-occupancy poller
+        self.role = role
+        self.kv_exports: List[dict] = []
+        self.kv_imports: List[dict] = []
+        self.tier_occupancy: List[int] = [0]
+        self.tier_slots: List[int] = [8]
         self.requests: List[dict] = []
         self.weight_updates: List[dict] = []
         # interleaved ("generate"|"update_weights", body) history — recovery
@@ -111,11 +124,45 @@ class FakeGenServer:
                 "output_logprobs": [-0.5] * len(out),
                 "stop_reason": stop,
                 "version": gen_version,
+                # the real engine echoes the client-pinned sampler stream
+                # (or the one it allocated) so a handoff leg 2 / failover
+                # resubmit continues the identical counter-keyed stream
+                "stream_id": int(body.get("stream_id", 0) or 0),
                 # the real engine reports how many prompt tokens hit the
                 # radix/paged prefix cache; the fake's analogue is the
                 # already-consumed completion carried back in the prompt
                 # (nonzero exactly on interruption/failover resubmits)
                 "cache_hit_tokens": done,
+            }
+        )
+
+    async def _kv_export(self, request: web.Request):
+        faulted = await self._maybe_fault(request, "/kv_export")
+        if faulted is not None:
+            return faulted
+        body = await request.json()
+        self.kv_exports.append(body)
+        ids = list(body.get("input_ids", []))
+        return web.json_response(
+            {"tokens": ids, "valid_len": len(ids), "nbytes": 64 * len(ids)}
+        )
+
+    async def _kv_import(self, request: web.Request):
+        faulted = await self._maybe_fault(request, "/kv_import")
+        if faulted is not None:
+            return faulted
+        body = await request.json()
+        self.kv_imports.append(body)
+        return web.json_response(
+            {"ok": True, "valid_len": int(body.get("valid_len", 0) or 0)}
+        )
+
+    async def _metrics(self, request: web.Request):
+        return web.json_response(
+            {
+                "role": self.role,
+                "tier_occupancy": list(self.tier_occupancy),
+                "tier_slots": list(self.tier_slots),
             }
         )
 
@@ -153,7 +200,9 @@ class FakeGenServer:
         faulted = await self._maybe_fault(request, "/health")
         if faulted is not None:
             return faulted
-        return web.json_response({"status": "ok", "version": self.version})
+        return web.json_response(
+            {"status": "ok", "version": self.version, "role": self.role}
+        )
 
     # --- lifecycle ---
     def _make_app(self) -> web.Application:
@@ -162,6 +211,9 @@ class FakeGenServer:
         app.router.add_post("/pause_generation", self._pause)
         app.router.add_post("/continue_generation", self._resume)
         app.router.add_post("/update_weights_from_disk", self._update_weights_from_disk)
+        app.router.add_post("/kv_export", self._kv_export)
+        app.router.add_post("/kv_import", self._kv_import)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
         return app
 
@@ -174,7 +226,9 @@ class FakeGenServer:
                 # short shutdown grace: a chaos-killed fleet member must die
                 # abruptly (keep-alive connections from router/client
                 # sessions would otherwise hold cleanup for 60 s)
-                runner = web.AppRunner(self._make_app(), shutdown_timeout=0.5)
+                runner = web.AppRunner(
+                    self._make_app(), shutdown_timeout=self.shutdown_grace
+                )
                 await runner.setup()
                 site = web.TCPSite(runner, "127.0.0.1", self._requested_port)
                 await site.start()

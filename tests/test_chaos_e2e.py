@@ -36,7 +36,12 @@ def _reward(prompt, completion, prompt_ids, completion_ids, **kw):
 
 def test_kill_one_of_two_mid_run_completes_and_rejoins(enabled_telemetry):
     completion = list(range(100, 108))
-    servers = [FakeGenServer(completion=completion, chunk_size=2)
+    # shutdown_grace < delay_s: the kill ABORTS the chunk it catches in
+    # flight instead of letting it finish, so the victim trajectory always
+    # fails client-side (a graceful close would let the health checker
+    # reroute every affinity before the client ever saw an error)
+    servers = [FakeGenServer(completion=completion, chunk_size=2,
+                             shutdown_grace=0.01)
                for _ in range(2)]
     for s in servers:
         s.delay_s = 0.05  # keep chunks in flight so the kill lands mid-run
@@ -60,8 +65,14 @@ def test_kill_one_of_two_mid_run_completes_and_rejoins(enabled_telemetry):
     eng.initialize(addr=raddr)
 
     def _assassin():
+        # wait for a CONTINUATION chunk (prompt grown past the 1-token
+        # original): that trajectory has accumulated tokens client-side,
+        # so aborting its in-flight chunk forces a resubmit that carries
+        # them — the warm-start path under test — deterministically
         deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and not servers[0].requests:
+        while time.monotonic() < deadline and not any(
+            len(r.get("input_ids", ())) > 1 for r in servers[0].requests
+        ):
             time.sleep(0.005)
         servers[0].stop()
 

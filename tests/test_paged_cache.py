@@ -231,6 +231,34 @@ def test_host_swap_mints_no_new_decode_programs(setup):
     )
 
 
+def test_cold_start_swap_in_mints_nothing(setup):
+    """ISSUE 17 satellite (the PR 16 cold-start caveat): engine init now
+    pre-compiles the whole gather/scatter bucket ladder AND leaves the
+    cache scatter-produced (out_shardings pins its aval), so the soak
+    starts COLD — no warm evict/swap-in round granted — and the first
+    real spill/swap-in/handoff cycle must mint zero programs anywhere."""
+    cfg, params = setup
+    rng = np.random.default_rng(33)
+    eng = _engine(cfg, params, n_slots=2, host_offload=True,
+                  host_cache_mb=8, host_min_tokens=8)
+    g0 = eng._host_gather_fn._cache_size()
+    s0 = eng._host_scatter_fn._cache_size()
+    assert g0 == s0 == len({16, 32, 64, 128})  # full ladder, compiled cold
+    warm = rng.integers(0, 97, 24).tolist()
+    _run_workload(eng, [[{"rid": "w", "ids": warm, "n": 12}]])
+    assert eng.stats["prefix_cache_host_swaps"] == 0  # still cold
+    baseline = eng._decode_fn._cache_size()
+    for i in range(3):  # evict/swap-in churn starts HERE, from cold
+        _run_workload(eng, [_fillers(np.random.default_rng(34 + i), 2)])
+        _run_workload(
+            eng, [[{"rid": f"w{i}", "ids": warm + [1, 2, 3], "n": 4}]]
+        )
+    assert eng.stats["prefix_cache_host_swaps"] >= 4
+    assert eng._decode_fn._cache_size() == baseline
+    assert eng._host_gather_fn._cache_size() == g0
+    assert eng._host_scatter_fn._cache_size() == s0
+
+
 def test_prefix_cache_stats_accounting(setup):
     """hits/misses/evictions line up with the admission composition, and
     the hit-rate helper reflects them."""

@@ -572,3 +572,187 @@ def test_drain_excludes_placement_but_keeps_fanout(fleet):
         assert len(servers[0].requests) == 1
     finally:
         h.stop()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode serving (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def _disagg_fleet(roles, completion=None):
+    from tests.fake_server import FakeGenServer as _F
+
+    servers = [
+        _F(completion=list(completion or range(100, 108)), role=r)
+        for r in roles
+    ]
+    return servers, [s.start() for s in servers]
+
+
+def test_disagg_two_leg_handoff_merges_stream():
+    """Happy path: leg 1 (one token) on the prefill server, /kv_export ->
+    /kv_import, leg 2 on ONE decode server with the pinned stream id,
+    and the merged response carries the full token stream."""
+    servers, addrs = _disagg_fleet(["prefill", "decode", "decode"])
+    router = Router(RouterConfig(disagg=True), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        status, out = _post(raddr, "/generate", {
+            "rid": "d0", "input_ids": [1, 2, 3], "stream_id": 77,
+            "sampling_params": {"max_new_tokens": 8},
+        })
+        assert status == 200
+        assert out["output_tokens"] == list(range(100, 108))
+        assert len(out["output_logprobs"]) == 8
+        assert out["handoff"] is True
+        prefill, d1, d2 = servers
+        assert len(prefill.requests) == 1
+        assert prefill.requests[0]["sampling_params"]["max_new_tokens"] == 1
+        assert prefill.requests[0]["stream_id"] == 77
+        assert len(prefill.kv_exports) == 1
+        assert prefill.kv_exports[0]["input_ids"] == [1, 2, 3, 100]
+        leg2 = [r for s in (d1, d2) for r in s.requests]
+        assert len(leg2) == 1
+        assert leg2[0]["input_ids"] == [1, 2, 3, 100]
+        assert leg2[0]["stream_id"] == 77
+        assert leg2[0]["sampling_params"]["max_new_tokens"] == 7
+        assert sum(len(s.kv_imports) for s in (d1, d2)) == 1
+        m = _get(raddr, "/metrics")
+        assert m["handoffs"] == 1 and m["handoff_fallbacks"] == 0
+        assert m["roles"][addrs[0]] == "prefill"
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_disagg_empty_role_pool_falls_back_colocated():
+    """`both` servers stay OUT of the role pools: with no prefill/decode
+    split available the router serves the request colocated in one leg."""
+    servers, addrs = _disagg_fleet(["both", "both"])
+    router = Router(RouterConfig(disagg=True), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        status, out = _post(raddr, "/generate", {
+            "rid": "c0", "input_ids": [1, 2],
+            "sampling_params": {"max_new_tokens": 8},
+        })
+        assert status == 200
+        assert out["output_tokens"] == list(range(100, 108))
+        assert "handoff" not in out
+        reqs = [r for s in servers for r in s.requests]
+        assert len(reqs) == 1  # one leg, no clipping
+        assert reqs[0]["sampling_params"]["max_new_tokens"] == 8
+        assert not any(s.kv_exports or s.kv_imports for s in servers)
+        assert _get(raddr, "/metrics")["handoffs"] == 0
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_disagg_import_failure_continues_on_prefill():
+    """A failed transfer (dead/refusing decode import) must not lose the
+    stream: leg 2 runs on the prefill server itself — exact under the
+    counter-keyed sampler — and counts a handoff fallback."""
+    from areal_tpu.utils.faults import Fault, FaultPlan
+
+    servers, addrs = _disagg_fleet(["prefill", "decode"])
+    servers[1].fault_plan = FaultPlan({("/kv_import", 0): Fault("http_500")})
+    router = Router(RouterConfig(disagg=True), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        status, out = _post(raddr, "/generate", {
+            "rid": "f0", "input_ids": [4, 5, 6],
+            "sampling_params": {"max_new_tokens": 8},
+        })
+        assert status == 200
+        assert out["output_tokens"] == list(range(100, 108))
+        assert out["handoff"] is False
+        prefill, decode = servers
+        # leg 1 AND the fallback leg 2 both landed on the prefill server
+        assert len(prefill.requests) == 2
+        assert len(decode.requests) == 0
+        m = _get(raddr, "/metrics")
+        assert m["handoffs"] == 0 and m["handoff_fallbacks"] == 1
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_disagg_finished_in_leg1_skips_transfer():
+    """EOS inside leg 1 (a one-token completion): nothing to hand off —
+    the leg-1 response is returned directly and no transfer happens."""
+    servers, addrs = _disagg_fleet(["prefill", "decode"], completion=[42])
+    router = Router(RouterConfig(disagg=True), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        status, out = _post(raddr, "/generate", {
+            "rid": "e0", "input_ids": [9],
+            "sampling_params": {"max_new_tokens": 8},
+        })
+        assert status == 200
+        assert out["output_tokens"] == [42]
+        assert out["stop_reason"] == "stop"
+        assert not servers[0].kv_exports and not servers[1].kv_imports
+        assert len(servers[1].requests) == 0
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_disagg_group_affinity_sticks_to_one_prefill():
+    """GRPO fan-out: group members must land on ONE prefill server (the
+    shared-prefix fan-out only works inside a single engine's cache)."""
+    servers, addrs = _disagg_fleet(["prefill", "prefill", "decode"])
+    router = Router(RouterConfig(disagg=True), addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        for i in range(4):
+            status, _ = _post(raddr, "/generate", {
+                "rid": f"g0-{i}", "group_id": "g0", "input_ids": [1, 2],
+                "sampling_params": {"max_new_tokens": 8},
+            })
+            assert status == 200
+        leg1_counts = sorted(len(s.requests) for s in servers[:2])
+        assert leg1_counts == [0, 4], leg1_counts
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_disagg_decode_pick_prefers_low_occupancy():
+    """Decode placement keys on tier occupancy from /metrics: a full
+    decode server loses placement to an idle one once the poller has a
+    sample."""
+    import time as _time
+
+    servers, addrs = _disagg_fleet(["prefill", "decode", "decode"])
+    servers[1].tier_occupancy, servers[1].tier_slots = [8], [8]  # full
+    servers[2].tier_occupancy, servers[2].tier_slots = [0], [8]  # idle
+    router = Router(RouterConfig(disagg=True, occupancy_poll_interval=0.1),
+                    addresses=addrs)
+    h = RouterHarness(router)
+    raddr = h.start()
+    try:
+        _time.sleep(0.6)  # let the occupancy poller sample both servers
+        for i in range(3):
+            status, _ = _post(raddr, "/generate", {
+                "rid": f"o{i}", "input_ids": [1, 2, 3],
+                "sampling_params": {"max_new_tokens": 8},
+            })
+            assert status == 200
+        assert len(servers[2].requests) == 3  # all tails on the idle one
+        assert len(servers[1].requests) == 0
+    finally:
+        h.stop()
+        for s in servers:
+            s.stop()
