@@ -1,6 +1,6 @@
-"""areal-lint: project-specific static analysis (ISSUE 3 + ISSUE 9).
+"""areal-lint: project-specific static analysis (ISSUE 3 + 9 + 18).
 
-Seven checkers tuned to this codebase's invariants, plus an opt-in
+Ten checkers tuned to this codebase's invariants, plus an opt-in
 runtime validator for the lock annotations:
 
 - C1 `unlocked-field`   (lock_discipline)  — guarded fields under locks
@@ -13,9 +13,19 @@ runtime validator for the lock annotations:
 - C6 `off-ladder-static` (jit_signatures)  — jit static-arg ladder proof
   + checked-in per-function signature budgets
 - C7 `slot-*` typestate  (typestate)       — slot/cache-row lifecycle
+- C8 `payload-contract` family (wire_contracts) — HTTP producer/consumer
+  key-sets vs the checked-in endpoint registry, both directions, incl.
+  silent `.get`-default reads of always-produced keys
+- C9 `metric-contract`/`event-contract` (wire_contracts) — every
+  telemetry metric pinned in tests/data/metrics_schema.json and every
+  emitted event consumed by obs/trace.py, bidirectionally (no orphans)
+- C10 `config-plumbing` (wire_contracts)   — GenServerConfig field →
+  build_cmd flag → gen/server.py argparse → engine kwarg, end-to-end
 
 C5–C7 share the interprocedural substrate in callgraph.py (class/lock
-index, call resolution, summary fixpoint).
+index, call resolution, summary fixpoint).  C8–C10 share the wire
+registry areal_tpu/analysis/wire_contracts.json (`wire-registry-stale`
+flags entries the code no longer backs).
 
 CLI: ``python scripts/lint.py --check`` (the tier-1 gate runs the same
 suite via tests/test_lint.py::test_repo_clean).  Catalog, annotation and

@@ -55,6 +55,13 @@ KNOWN_RULES = frozenset(
         "slot-double-free",
         "slot-lifecycle",
         "retained-unversioned",
+        # v3 cross-process wire-contract checkers (ISSUE 18)
+        "payload-contract",
+        "payload-silent-default",
+        "metric-contract",
+        "event-contract",
+        "config-plumbing",
+        "wire-registry-stale",
     }
 )
 
@@ -252,6 +259,7 @@ def run_suite(root: str, package: str = "areal_tpu") -> List[Finding]:
     from areal_tpu.analysis.lock_discipline import check_lock_discipline
     from areal_tpu.analysis.lock_order import check_lock_order
     from areal_tpu.analysis.typestate import check_typestate
+    from areal_tpu.analysis.wire_contracts import check_wire_contracts
 
     files = load_files(root)
     findings: List[Finding] = []
@@ -267,6 +275,7 @@ def run_suite(root: str, package: str = "areal_tpu") -> List[Finding]:
     findings.extend(check_lock_order(files))
     findings.extend(check_typestate(files))
     findings.extend(check_jit_signatures(files, root))
+    findings.extend(check_wire_contracts(files, root))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 

@@ -269,6 +269,15 @@ class GenServerConfig:
     spec_decode: bool = False
     spec_ladder: List[int] = field(default_factory=list)
     spec_draft_len: int = 0
+    # Disaggregated-fleet role (ISSUE 17): prefill | decode | both.  The
+    # launcher must plumb this through --role or every server comes up
+    # colocated and the router's role pools stay empty.
+    role: str = "both"
+    # Host-DRAM overflow tier for evicted retained prefixes (ISSUE 16);
+    # --role decode implies it server-side, but launchers should set it
+    # explicitly so the capacity flag below is honored.
+    host_offload: bool = False
+    host_cache_mb: int = 64
 
     @staticmethod
     def build_cmd(
@@ -288,7 +297,13 @@ class GenServerConfig:
             f"--n-slots={config.max_seqs}",
             f"--max-seq-len={config.max_context_len}",
             f"--tp={max(1, config.mesh.tensor_parallel_size)}",
+            f"--ep={max(1, config.mesh.expert_parallel_size)}",
         ]
+        if config.role != "both":
+            args.append(f"--role={config.role}")
+        if config.host_offload:
+            args.append("--host-offload")
+            args.append(f"--host-cache-mb={config.host_cache_mb}")
         if not config.decode_window:
             args.append("--no-decode-window")
         if config.decode_tiers > 1:

@@ -519,10 +519,7 @@ class RemoteInfEngine(InferenceEngine):
             for a, exc in failed.items():
                 logger.warning(f"control-plane fanout to {a} failed: {exc!r}")
             if failed:
-                telemetry.TRAIN.counter(
-                    "publish_partial_failures_total",
-                    "servers missed by client control-plane fanouts",
-                ).inc(len(failed))
+                telemetry.PUBLISH_PARTIAL_FAILURES.inc(len(failed))
             if len(failed) == len(self.addresses):
                 raise RuntimeError(
                     f"control-plane fanout reached no server: "

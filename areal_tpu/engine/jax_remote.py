@@ -47,7 +47,9 @@ class JaxBackend:
                 "top_p": g.top_p,
                 "top_k": g.top_k,
                 "stop_token_ids": list(g.stop_token_ids),
-                "frequency_penalty": g.frequency_penalty,
+                # frequency_penalty is NOT forwarded: the JAX sampler has no
+                # penalty support, and shipping the key would silently imply
+                # it does (C8 payload-contract drift class).
             },
         }
         if req.pixel_values is not None:

@@ -592,9 +592,17 @@ class GenServer:
                 text=telemetry.GEN.render_prometheus(),
                 content_type="text/plain",
             )
-        # engine.stats lookups go through .get so a stats-key rename degrades
-        # a counter to 0 instead of 500ing the whole scrape
+        # engine.stats lookups go through _stat so a stats-key rename
+        # degrades a counter to 0 instead of 500ing the whole scrape — but
+        # every degraded lookup is counted (areal_gen_stats_key_misses_total)
+        # so the drift is visible on the Prometheus surface (ISSUE 18)
         stats = self.engine.stats
+
+        def _stat(key: str):
+            if key not in stats:
+                telemetry.GEN_STATS_KEY_MISSES.inc()
+            return stats.get(key, 0)
+
         return web.json_response(
             {
                 "decode_steps": self.step_count,
@@ -607,17 +615,17 @@ class GenServer:
                 "staged": self.engine.has_standby,
                 # prefill-side token accounting: cold vs retained-reuse vs
                 # group fan-out (shared) — the grouped-prefill savings
-                "prefill_tokens": stats.get("prefill_tokens", 0),
-                "suffix_tokens": stats.get("suffix_tokens", 0),
-                "reused_tokens": stats.get("reused_tokens", 0),
-                "shared_tokens": stats.get("shared_tokens", 0),
-                "copy_calls": stats.get("copy_calls", 0),
+                "prefill_tokens": _stat("prefill_tokens"),
+                "suffix_tokens": _stat("suffix_tokens"),
+                "reused_tokens": _stat("reused_tokens"),
+                "shared_tokens": _stat("shared_tokens"),
+                "copy_calls": _stat("copy_calls"),
                 # abort-reservation TTL observability (VERDICT r6 #10):
                 # reservations that expired unclaimed — nonzero means
                 # aborted clients are not resubmitting within
                 # abort_reserve_s and the retained-prefix handoff is
                 # silently degrading to fresh prefills
-                "reservations_lapsed": stats.get("reservations_lapsed", 0),
+                "reservations_lapsed": _stat("reservations_lapsed"),
                 # tiered decode (ISSUE 5): attended span / configured
                 # ceiling over all decode dispatches (1.0 = paying the
                 # full max_seq_len width), per-cohort occupancy, and
@@ -628,42 +636,36 @@ class GenServer:
                 "tier_occupancy": self.engine.tier_occupancy(),
                 "tier_slots": list(self.engine.tier_size),
                 "tier_lens": list(self.engine.tier_bounds),
-                "tier_migrations": stats.get("tier_migrations", 0),
+                "tier_migrations": _stat("tier_migrations"),
                 # speculative decode (ISSUE 12): draft/accept counters and
                 # the lifetime acceptance rate; per-tier windowed rates
                 # live on the Prometheus surface (spec_acceptance_rate)
-                "spec_drafted": stats.get("spec_drafted", 0),
-                "spec_accepted": stats.get("spec_accepted", 0),
+                "spec_drafted": _stat("spec_drafted"),
+                "spec_accepted": _stat("spec_accepted"),
                 "spec_acceptance_rate": round(
-                    stats.get("spec_accepted", 0)
-                    / max(1, stats.get("spec_drafted", 0)),
+                    _stat("spec_accepted")
+                    / max(1, _stat("spec_drafted")),
                     4,
                 ),
-                "verify_calls": stats.get("verify_calls", 0),
+                "verify_calls": _stat("verify_calls"),
                 # unified radix/paged prefix cache (ISSUE 16): admission
                 # hits/misses through the one shared mechanism, device
                 # evictions, and host-DRAM spill/swap-in round trips
-                "prefix_cache_hits": stats.get("prefix_cache_hits", 0),
-                "prefix_cache_misses": stats.get("prefix_cache_misses", 0),
-                "prefix_cache_evictions": stats.get(
-                    "prefix_cache_evictions", 0
-                ),
-                "prefix_cache_host_swaps": stats.get(
-                    "prefix_cache_host_swaps", 0
-                ),
+                "prefix_cache_hits": _stat("prefix_cache_hits"),
+                "prefix_cache_misses": _stat("prefix_cache_misses"),
+                "prefix_cache_evictions": _stat("prefix_cache_evictions"),
+                "prefix_cache_host_swaps": _stat("prefix_cache_host_swaps"),
                 "prefix_cache_hit_rate": round(
                     self.engine.prefix_cache_hit_rate(), 4
                 ),
-                "prefix_cache_partial_hits": stats.get(
-                    "prefix_cache_partial_hits", 0
-                ),
+                "prefix_cache_partial_hits": _stat("prefix_cache_partial_hits"),
                 # disaggregated prefill/decode handoff (ISSUE 17): the
                 # router's decode-pool placement reads tier_occupancy
                 # above; these counters are the transfer ledger
-                "kv_handoff_exports": stats.get("kv_handoff_exports", 0),
-                "kv_handoff_imports": stats.get("kv_handoff_imports", 0),
-                "kv_handoff_bytes": stats.get("kv_handoff_bytes", 0),
-                "kv_handoff_failures": stats.get("kv_handoff_failures", 0),
+                "kv_handoff_exports": _stat("kv_handoff_exports"),
+                "kv_handoff_imports": _stat("kv_handoff_imports"),
+                "kv_handoff_bytes": _stat("kv_handoff_bytes"),
+                "kv_handoff_failures": _stat("kv_handoff_failures"),
             }
         )
 

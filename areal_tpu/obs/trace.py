@@ -53,8 +53,8 @@ _TERMINAL_EVENTS = ("gen_done", "rollout_lost")
 # Per-trace events that require a root to be meaningful; seeing one for
 # a trace with no root means the head of the log was lost.
 _REQUIRES_ROOT = (
-    "prefill", "resume", "resubmit", "interrupt", "reward",
-    "gen_done", "rollout_lost", "handoff",
+    "prefill", "resume", "resubmit", "resubmit_cache_hit", "interrupt",
+    "reward", "gen_done", "rollout_lost", "handoff",
 )
 # Global (traceless) events: never orphan candidates.  run_restart marks
 # a trainer relaunch resuming from a recover generation (utils/recover.py)
@@ -123,6 +123,8 @@ class TrajectoryRecord:
     stop_reason: Optional[str] = None
     attempts: int = 1
     resubmits: int = 0
+    resubmit_cache_hits: int = 0
+    resubmit_cache_hit_tokens: int = 0
     interrupts: int = 0
     handoffs: int = 0
     handoff_bytes: int = 0
@@ -290,6 +292,12 @@ def _build_record(trace_id: str, events: List[Dict[str, Any]]) -> TrajectoryReco
             state = "interrupted"
             if name == "resubmit":
                 rec.resubmits += 1
+        elif name == "resubmit_cache_hit":
+            # A failover resubmit whose replacement server warm-started the
+            # accumulated prefix through the radix/paged cache (ISSUE 16).
+            # Pure annotation on the in-flight attempt: no stage boundary.
+            rec.resubmit_cache_hits += 1
+            rec.resubmit_cache_hit_tokens += int(e.get("hit_tokens", 0) or 0)
         elif name in _TERMINAL_EVENTS:
             # Delivery + HTTP return after the last decode chunk is its
             # own "tail" stage; any other state closes into itself

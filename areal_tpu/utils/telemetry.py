@@ -393,6 +393,25 @@ TRAIN_RECOVER = TRAIN.counter(
     "Trainer restarts that resumed from a recover checkpoint generation",
 )
 
+# Control-plane fanouts (update_weights / set_version / pause / continue)
+# that missed at least one server.  Eager registration so the pinned name
+# serves a TYPE line before the first partial failure; core/remote.py's
+# fanout path increments it by the number of servers missed.
+PUBLISH_PARTIAL_FAILURES = TRAIN.counter(
+    "publish_partial_failures_total",
+    "Servers missed by client control-plane fanouts",
+)
+
+# The silent-0 class made visible at runtime (ISSUE 18): the legacy
+# /metrics JSON in gen/server.py reads engine.stats through a tolerant
+# .get so a stats-key rename degrades the reported counter to 0 instead
+# of 500ing the scrape — this counts every such degraded lookup so the
+# drift shows up on the Prometheus surface instead of hiding in a zero.
+GEN_STATS_KEY_MISSES = GEN.counter(
+    "stats_key_misses_total",
+    "Legacy /metrics JSON lookups of engine.stats keys that were absent",
+)
+
 
 # ---------------------------------------------------------------------------
 # Event log

@@ -11,6 +11,8 @@
                                             # only NEW findings fail
     python scripts/lint.py --write-budget   # regenerate the C6 signature
                                             # budget (analysis/signature_budget.json)
+    python scripts/lint.py --explain C8     # print a wire-contract checker's
+                                            # catalog entry (C8|C9|C10)
 
 Baseline fingerprints are (path, rule, message) hashes — stable across
 unrelated line drift, invalidated when the finding itself changes.
@@ -59,6 +61,62 @@ REFERENCE_CONFIGS = {
         "decode_tiers": 2,
         "spec_rungs": 2,
     },
+}
+
+
+# --explain catalog: one entry per wire-contract checker (the full C1–C10
+# catalog with worked examples lives in docs/lint.md).
+EXPLAIN = {
+    "C8": """\
+C8 — cross-process payload contracts
+rules: payload-contract, payload-silent-default
+registry: areal_tpu/analysis/wire_contracts.json (endpoints/apps/
+          client_targets/post_helpers/bindings)
+
+Per HTTP endpoint, the checker extracts producer key-sets (dict literals
+and payload["k"] writes flowing into session.post/HttpRequest/helper
+calls in core/remote.py, gen/router.py, scripts/bench_replay.py,
+tests/fake_server.py) and consumer key-sets (body["k"] / body.get("k", d)
+reads in gen/server.py + gen/router.py handlers, and response-field reads
+back in the clients).  Findings:
+  - a hard read (body["k"]) of a key no producer writes      -> error
+  - a producer writing a key the contract does not declare   -> error
+  - a closed producer literal omitting a required key        -> error
+  - .get with a silent constant/empty-literal default on a key every
+    producer writes (the silent-0 class)      -> payload-silent-default
+  - a contract key nothing produces/reads     -> wire-registry-stale
+Response bodies are checked in the reverse direction.  Suppress inline
+with `# areal-lint: disable=payload-contract <reason>`; registry-anchored
+findings are fixed by editing wire_contracts.json, not suppressed.""",
+    "C9": """\
+C9 — telemetry name contracts (bidirectional)
+rules: metric-contract, event-contract
+registry: wire_contracts.json (events.names, metrics.dynamic_sites/
+          dynamic_patterns/unpinned) + tests/data/metrics_schema.json
+
+Every Counter/Gauge/Histogram constructed anywhere must resolve to a name
+pinned in tests/data/metrics_schema.json, and every pinned name must be
+constructed by code (no orphans in either direction; dynamically-named
+constructions are only allowed in metrics.dynamic_sites files and are
+covered by metrics.dynamic_patterns on the reverse pass).  Every event
+name passed to telemetry.emit must be declared in events.names AND
+consumed by obs/trace.py's parser, and vice versa — emitted-but-never-
+parsed spans and parsed-but-never-emitted ghosts are both findings.
+Exemptions live in the registry (emit_exempt / consume_exempt, each with
+a reason).""",
+    "C10": """\
+C10 — config plumbing
+rule: config-plumbing
+registry: wire_contracts.json (config_chains.files / config_chains.chains)
+
+Each chain pins one knob end-to-end:
+  GenServerConfig field -> build_cmd emission -> gen/server.py argparse
+  flag -> GenEngine kwarg (direct or via a **splat dict).
+Findings: a chained field/flag/kwarg missing at any hop; build_cmd
+emitting a flag argparse rejects (launched servers crash); any argparse
+flag, config field, or build_cmd flag not covered by a chain (add a
+chain, or a config_only/server_only entry with a reason).  This is the
+--role/--host-cache-mb drift class PRs 16-17 maintained by hand.""",
 }
 
 
@@ -170,7 +228,18 @@ def main(argv=None) -> int:
         help="regenerate areal_tpu/analysis/signature_budget.json from the "
         "reference soak configs and exit",
     )
+    p.add_argument(
+        "--explain",
+        metavar="CHECKER",
+        choices=tuple(EXPLAIN),
+        help="print the catalog entry for a wire-contract checker "
+        f"({', '.join(EXPLAIN)}) and exit",
+    )
     args = p.parse_args(argv)
+
+    if args.explain:
+        print(EXPLAIN[args.explain])
+        return 0
 
     if args.write_budget:
         path = write_budget(args.root)

@@ -1,0 +1,19 @@
+"""C10 fixture: the config side of a clean field -> flag -> engine-kwarg
+chain (CFG_DOC in test_lint.py)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class TinyServerConfig:
+    depth: int = 1
+    width: int = 2
+
+    @staticmethod
+    def build_cmd(config, port):
+        args = [
+            "prog",
+            f"--depth={config.depth}",
+            f"--width={config.width}",
+        ]
+        return " ".join(args)

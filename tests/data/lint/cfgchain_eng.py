@@ -1,0 +1,7 @@
+"""C10 fixture: the engine side — __init__ params the chains target."""
+
+
+class TinyEngine:
+    def __init__(self, depth=1, width=2):
+        self.depth = depth
+        self.width = width

@@ -1,0 +1,16 @@
+"""C10 fixture: the clean server side — every argparse flag reaches the
+engine call as a kwarg."""
+
+import argparse
+
+
+class TinyEngine:  # stand-in so the fixture is self-contained
+    pass
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=1)
+    p.add_argument("--width", type=int, default=2)
+    args = p.parse_args()
+    return TinyEngine(depth=args.depth, width=args.width)

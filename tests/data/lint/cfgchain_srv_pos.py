@@ -1,0 +1,18 @@
+"""C10 positive fixture: the broken server side — `--width` renamed away
+from the chained flag, the parsed value dropped before the engine call,
+and an uncovered extra flag."""
+
+import argparse
+
+
+class TinyEngine:
+    pass
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=1)
+    p.add_argument("--extra", type=int, default=0)  # VIOLATION: uncovered
+    args = p.parse_args()
+    # VIOLATION: width is chained but never passed (and --width is gone)
+    return TinyEngine(depth=args.depth)

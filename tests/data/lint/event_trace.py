@@ -1,0 +1,11 @@
+"""C9 fixture: the trace-consumer side for the metric/event fixtures —
+consumes exactly `ev_done` via both a tuple constant and a compare."""
+
+_EVENTS = ("ev_done",)
+
+
+def consume(e):
+    name = e.get("event")
+    if e.get("event") == "ev_done":
+        return True
+    return name in _EVENTS

@@ -1,0 +1,12 @@
+"""C9 negative fixture: a pinned static metric and a declared, consumed
+lifecycle event (METRIC_DOC / METRIC_SCHEMA in test_lint.py; the matching
+consumer lives in event_trace.py)."""
+
+from areal_tpu.utils import telemetry
+
+REQS = telemetry.GEN.counter("good_total", "requests served")
+
+
+def emit_done(trace_id):
+    REQS.inc()
+    telemetry.emit("ev_done", trace_id=trace_id)

@@ -1,0 +1,16 @@
+"""C9 positive fixture: unpinned metric, dynamic metric name outside an
+allowlisted site, undeclared event emit, and a declared-but-never-parsed
+event (METRIC_POS_DOC / METRIC_POS_SCHEMA in test_lint.py)."""
+
+from areal_tpu.utils import telemetry
+
+BAD = telemetry.GEN.counter("bad_total", "never pinned")  # VIOLATION
+
+
+def dyn(name):
+    return telemetry.GEN.counter(name)  # VIOLATION: dynamic, not allowlisted
+
+
+def emit_all():
+    telemetry.emit("ghost_ev")  # VIOLATION: not declared in the registry
+    telemetry.emit("ev_unparsed")  # VIOLATION: trace.py never consumes it

@@ -1,0 +1,28 @@
+"""C8 negative fixture: a producer/consumer pair in exact agreement with
+the fixture registry (WIRE_DOC in test_lint.py).  Every request key the
+client writes is read by the handler, every response key the handler
+writes is declared, and the only `.get` with a default is either on an
+optional key or computes its fallback (tolerant read)."""
+
+from aiohttp import web
+
+
+class PingServer:
+    async def ping(self, request):
+        body = await request.json()
+        x = body["x"]
+        opt = body.get("opt", str(x))  # tolerant: computed fallback
+        return web.json_response({"y": x, "echo": opt})
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_post("/ping", self.ping)
+        return app
+
+
+async def call_ping(session, addr):
+    resp = await session.post(
+        f"http://{addr}/ping", json={"x": 1, "opt": "o"}
+    )
+    data = await resp.json()
+    return data["y"], data.get("echo", None)  # echo is optional

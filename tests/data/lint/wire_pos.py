@@ -1,0 +1,32 @@
+"""C8 positive fixture: every cross-process drift class the payload
+checker must catch, against the fixture registry (WIRE_DOC in
+test_lint.py: /ping request {x required, opt}, response {y required})."""
+
+from aiohttp import web
+
+
+class PingServer:
+    async def ping(self, request):
+        body = await request.json()
+        ghost = body["ghost"]  # VIOLATION: hard read, no producer writes it
+        x = body.get("x", 0)  # VIOLATION: silent default on a required key
+        return web.json_response({"y": x + ghost})
+
+    def make_app(self):
+        app = web.Application()
+        app.router.add_post("/ping", self.ping)
+        return app
+
+
+async def call_ping_extra(session, addr):
+    resp = await session.post(
+        f"http://{addr}/ping",
+        json={"x": 1, "bogus": 2},  # VIOLATION: key not in the contract
+    )
+    data = await resp.json()
+    return data["zzz"]  # VIOLATION: response key no handler produces
+
+
+async def call_ping_missing(session, addr):
+    # VIOLATION: closed literal omits the required key 'x'
+    await session.post(f"http://{addr}/ping", json={"opt": "o"})

@@ -122,6 +122,9 @@ class FakeGenServer:
             {
                 "output_tokens": out,
                 "output_logprobs": [-0.5] * len(out),
+                # the real engine stamps every token with the weight version
+                # active when it was sampled (staleness accounting reads it)
+                "output_versions": [gen_version] * len(out),
                 "stop_reason": stop,
                 "version": gen_version,
                 # the real engine echoes the client-pinned sampler stream
@@ -142,9 +145,21 @@ class FakeGenServer:
             return faulted
         body = await request.json()
         self.kv_exports.append(body)
+        # the recorder keeps the raw body; the wire read below tolerates an
+        # empty probe request from transport-level tests
+        # areal-lint: disable=payload-silent-default fake export of an empty prefix is a valid degenerate entry
         ids = list(body.get("input_ids", []))
+        # full kv_pool.wire_encode_entry shape — router leg-2 import decodes
+        # version/block/kv, so a fake omitting them would mask real drift
         return web.json_response(
-            {"tokens": ids, "valid_len": len(ids), "nbytes": 64 * len(ids)}
+            {
+                "tokens": ids,
+                "valid_len": len(ids),
+                "version": self.version,
+                "block": 0,
+                "nbytes": 64 * len(ids),
+                "kv": {},
+            }
         )
 
     async def _kv_import(self, request: web.Request):

@@ -112,3 +112,34 @@ def test_ignore_unknown_top_level_only(tmp_path):
     with pytest.raises(ValueError, match="grp_size"):
         load_expr_config(["--config", str(p2)], GRPOConfig,
                          ignore_unknown_top=True)
+
+
+def test_build_cmd_plumbs_role_host_tier_and_parallel_flags():
+    """Regression (ISSUE 18 / C10 config-plumbing): the PR-16/17 knobs
+    (role split, host-DRAM tier, expert parallelism) must flow
+    GenServerConfig -> build_cmd -> gen/server.py argparse; until this PR
+    build_cmd silently dropped all four, so every launcher-started server
+    came up colocated with the host tier off."""
+    from areal_tpu.api.config import GenServerConfig, MeshConfig
+
+    cfg = GenServerConfig(
+        model_path="/m",
+        role="decode",
+        host_offload=True,
+        host_cache_mb=128,
+        mesh=MeshConfig(tensor_parallel_size=2, expert_parallel_size=4),
+    )
+    cmd = GenServerConfig.build_cmd(cfg, host="h", port=1234)
+    assert "--role=decode" in cmd
+    assert "--host-offload" in cmd
+    assert "--host-cache-mb=128" in cmd
+    assert "--tp=2" in cmd
+    assert "--ep=4" in cmd
+    # defaults stay flagless: gen/server.py's argparse defaults are
+    # authoritative for the colocated case
+    default_cmd = GenServerConfig.build_cmd(
+        GenServerConfig(model_path="/m"), host="h", port=0
+    )
+    assert "--role" not in default_cmd
+    assert "--host-offload" not in default_cmd
+    assert "--host-cache-mb" not in default_cmd

@@ -504,3 +504,33 @@ def test_generate_batch_groups_share_prefix(live_server):
     hits = sorted(r["cache_hit_tokens"] for r in out["results"])
     assert hits[0] == 0  # the representative cold-prefilled
     assert hits[-1] >= len(prompt) - 1  # siblings rode its prefix K/V
+
+
+def test_stats_key_miss_is_counted_not_silent(live_server):
+    """ISSUE 18 satellite: an absent/renamed engine.stats key must not
+    silently degrade to 0 in the legacy /metrics JSON — every tolerant
+    fallback lookup increments areal_gen_stats_key_misses_total so the
+    drift is visible on the Prometheus surface."""
+    import json
+    import urllib.request
+
+    engine, addr = live_server
+    removed = engine.stats.pop("copy_calls", None)
+    try:
+        legacy = json.loads(urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=10).read())
+        # the scrape still serves (tolerant fallback) ...
+        assert legacy["copy_calls"] == 0
+        prom = urllib.request.urlopen(
+            f"http://{addr}/metrics?format=prometheus", timeout=10
+        ).read().decode()
+        lines = [
+            ln for ln in prom.splitlines()
+            if ln.startswith("areal_gen_stats_key_misses_total")
+        ]
+        # ... but the degradation is counted, not silent
+        assert lines, "stats-miss counter missing from the scrape surface"
+        assert float(lines[0].split()[-1]) >= 1.0
+    finally:
+        if removed is not None:
+            engine.stats["copy_calls"] = removed

@@ -1,9 +1,10 @@
-"""areal-lint (ISSUE 3 + ISSUE 9): fixture coverage for all seven
-checkers, the mutation acceptance cases (fixture AND real engine/router:
-deleted locks, reordered acquisitions, off-ladder statics, double-free),
-the signature-budget math cross-checks, the suppression-hygiene rules,
-the AREAL_DEBUG_LOCKS runtime assertions, the CLI output formats, and
-the tier-1 repo-clean gate."""
+"""areal-lint (ISSUE 3 + 9 + 18): fixture coverage for all ten
+checkers, the mutation acceptance cases (fixture AND real code: deleted
+locks, reordered acquisitions, off-ladder statics, double-free, renamed
+wire keys, dropped schema metrics, broken config chains), the
+signature-budget math cross-checks, the suppression-hygiene rules, the
+AREAL_DEBUG_LOCKS runtime assertions, the CLI output formats, and the
+tier-1 repo-clean gate."""
 
 import asyncio
 import json
@@ -34,6 +35,12 @@ from areal_tpu.analysis.lock_discipline import check_lock_discipline
 from areal_tpu.analysis.lock_order import check_lock_order
 from areal_tpu.analysis.lockcheck import LockDisciplineError, lock_guarded
 from areal_tpu.analysis.typestate import check_typestate
+from areal_tpu.analysis.wire_contracts import (
+    WireContracts,
+    check_config_plumbing,
+    check_payload_contracts,
+    check_telemetry_contracts,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "data", "lint")
@@ -48,6 +55,11 @@ def _fixture(name: str) -> SourceFile:
 @pytest.fixture(scope="module")
 def repo_findings():
     return run_suite(REPO)
+
+
+@pytest.fixture(scope="module")
+def repo_files():
+    return load_files(REPO)
 
 
 # ------------------------------- C1 ---------------------------------
@@ -602,6 +614,21 @@ def test_cli_write_budget_is_idempotent(tmp_path):
     )
 
 
+def test_cli_explain_prints_wire_checker_catalog(capsys):
+    """`--explain C8|C9|C10` prints the catalog entry and exits 0 without
+    running the suite (ISSUE 18 satellite)."""
+    cli = _load_cli()
+    for checker, rule in (
+        ("C8", "payload-contract"),
+        ("C9", "metric-contract"),
+        ("C10", "config-plumbing"),
+    ):
+        assert cli.main(["--explain", checker]) == 0
+        out = capsys.readouterr().out
+        assert rule in out
+        assert "wire_contracts.json" in out
+
+
 # ------------------------------ the gate -----------------------------
 
 
@@ -610,3 +637,205 @@ def test_repo_clean(repo_findings):
     same condition as `python scripts/lint.py --check`."""
     active = unsuppressed(repo_findings)
     assert active == [], "\n" + "\n".join(f.render() for f in active)
+
+
+# ----------------------- C8/C9/C10 (ISSUE 18) ------------------------
+
+
+def _wire_doc(apps, echo=True):
+    response = {"y": {"required": True}}
+    if echo:
+        response["echo"] = {}
+    return {
+        "endpoints": {
+            "ping": {
+                "path": "/ping",
+                "app": "gen",
+                "request": {"x": {"required": True}, "opt": {}},
+                "response": response,
+            }
+        },
+        "apps": apps,
+    }
+
+
+def test_wire_payload_negative_fixture_is_clean():
+    sf = _fixture("wire_neg")
+    wc = WireContracts(_wire_doc({"wire_neg": "gen"}))
+    assert check_payload_contracts({sf.rel: sf}, contracts=wc) == []
+
+
+def test_wire_payload_positive_fixture_flags_every_drift_class():
+    sf = _fixture("wire_pos")
+    wc = WireContracts(_wire_doc({"wire_pos": "gen"}, echo=False))
+    findings = check_payload_contracts({sf.rel: sf}, contracts=wc)
+    msgs = [f.message for f in findings]
+    assert sum(f.rule == "payload-silent-default" for f in findings) == 1
+    assert any("'ghost'" in m for m in msgs)  # read no producer writes
+    assert any("'bogus'" in m for m in msgs)  # write not in contract
+    assert any("'zzz'" in m for m in msgs)  # response read no one writes
+    assert any("omits required key 'x'" in m for m in msgs)
+    assert len(findings) == 5, "\n".join(f.render() for f in findings)
+
+
+def test_renaming_wire_key_is_caught_in_fixture():
+    """Acceptance: renaming the produced key in the CLEAN fixture must
+    produce both an unknown-write and a missing-required finding."""
+    src = open(os.path.join(FIXTURES, "wire_neg.py")).read()
+    assert 'json={"x": 1, "opt": "o"}' in src
+    mutated = src.replace('json={"x": 1, "opt": "o"}',
+                          'json={"x_new": 1, "opt": "o"}')
+    sf = SourceFile("wire_neg_mut", mutated, rel="wire_neg_mut")
+    wc = WireContracts(_wire_doc({"wire_neg_mut": "gen"}))
+    findings = check_payload_contracts({sf.rel: sf}, contracts=wc)
+    msgs = [f.message for f in findings]
+    assert any("'x_new'" in m for m in msgs)
+    assert any("omits required key 'x'" in m for m in msgs)
+
+
+def test_renaming_real_fake_server_key_is_caught(repo_files):
+    """Acceptance (real code): renaming output_versions in the fake
+    server — the exact PR-17 drift class this checker exists for."""
+    src = open(os.path.join(REPO, "tests", "fake_server.py")).read()
+    assert '"output_versions"' in src
+    mutated = src.replace('"output_versions"', '"output_versionz"')
+    sf = SourceFile("fake_server_mut", mutated,
+                    rel=os.path.join("tests", "fake_server.py"))
+    findings = check_payload_contracts(repo_files, REPO, fake_server=sf)
+    active = [f for f in findings if not f.suppressed]
+    assert any("output_versionz" in f.message for f in active)
+    assert any("omits required key 'output_versions'" in f.message
+               for f in active)
+
+
+def test_metric_event_negative_fixture_is_clean():
+    sf = _fixture("metric_neg")
+    wc = WireContracts({"events": {"names": [{"name": "ev_done"}]}})
+    findings = check_telemetry_contracts(
+        {sf.rel: sf}, contracts=wc,
+        schema={"gen": ["areal_gen_good_total"]},
+        trace_sf=_fixture("event_trace"),
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_metric_event_positive_fixture_flags_all():
+    sf = _fixture("metric_pos")
+    wc = WireContracts({"events": {"names": [
+        {"name": "ev_unparsed"}, {"name": "ev_never"},
+    ]}})
+    findings = check_telemetry_contracts(
+        {sf.rel: sf}, contracts=wc,
+        schema={"gen": ["areal_gen_orphan_total"]},
+        trace_sf=_fixture("event_trace"),
+    )
+    msgs = [f.message for f in findings]
+    # metric side: unpinned static name, dynamic name, schema orphan
+    assert any("'bad_total'" in m for m in msgs)
+    assert any("dynamically-named" in m for m in msgs)
+    assert any("orphaned schema entry" in m for m in msgs)
+    # event side: undeclared emit, emitted-but-never-parsed,
+    # declared-but-never-emitted/consumed (both directions of ev_never),
+    # parsed-but-undeclared ghost in the trace fixture
+    assert any("'ghost_ev'" in m for m in msgs)
+    assert any("'ev_unparsed' is emitted but" in m for m in msgs)
+    assert any("'ev_never' is declared but nothing emits" in m for m in msgs)
+    assert any("'ev_never' is declared but obs/trace.py never" in m
+               for m in msgs)
+    assert any("parses event 'ev_done'" in m for m in msgs)
+    assert len(findings) == 8, "\n".join(f.render() for f in findings)
+
+
+def test_dropping_real_metric_from_schema_is_caught(repo_files):
+    """Acceptance (real code): removing a pinned metric the code still
+    constructs must flag the construction site."""
+    with open(os.path.join(REPO, "tests", "data",
+                           "metrics_schema.json")) as fh:
+        schema = json.load(fh)
+    schema = {
+        surface: [n for n in names if n != "areal_train_recover_total"]
+        for surface, names in schema.items()
+    }
+    findings = check_telemetry_contracts(repo_files, REPO, schema=schema)
+    assert any(
+        f.rule == "metric-contract" and "areal_train_recover_total"
+        in f.message and not f.suppressed
+        for f in findings
+    )
+
+
+def test_orphan_schema_metric_is_caught(repo_files):
+    with open(os.path.join(REPO, "tests", "data",
+                           "metrics_schema.json")) as fh:
+        schema = json.load(fh)
+    schema["train"] = schema["train"] + ["areal_train_ghost_metric"]
+    findings = check_telemetry_contracts(repo_files, REPO, schema=schema)
+    assert any(
+        "orphaned schema entry" in f.message
+        and "areal_train_ghost_metric" in f.message
+        for f in findings
+    )
+
+
+CFG_DOC = {
+    "config_chains": {
+        "files": {
+            "config": "cfgchain_cfg",
+            "server": "cfgchain_srv",
+            "engine": "cfgchain_eng",
+            "config_class": "TinyServerConfig",
+            "build_cmd": "build_cmd",
+            "engine_class": "TinyEngine",
+        },
+        "chains": [
+            {"field": "depth", "flag": "--depth", "engine_kwarg": "depth"},
+            {"field": "width", "flag": "--width", "engine_kwarg": "width"},
+        ],
+    }
+}
+
+
+def _cfg_files(server_fixture="cfgchain_srv"):
+    files = {
+        "cfgchain_cfg": _fixture("cfgchain_cfg"),
+        "cfgchain_eng": _fixture("cfgchain_eng"),
+    }
+    files["cfgchain_srv"] = SourceFile.from_path(
+        os.path.join(FIXTURES, server_fixture + ".py"), rel="cfgchain_srv"
+    )
+    return files
+
+
+def test_config_chain_negative_fixture_is_clean():
+    findings = check_config_plumbing(
+        _cfg_files(), contracts=WireContracts(CFG_DOC)
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_config_chain_positive_fixture_flags_every_break():
+    findings = check_config_plumbing(
+        _cfg_files("cfgchain_srv_pos"), contracts=WireContracts(CFG_DOC)
+    )
+    msgs = [f.message for f in findings]
+    assert any("argparse has no '--width'" in m for m in msgs)
+    assert any("never passes 'width'" in m for m in msgs)
+    assert any("'--extra' is not covered" in m for m in msgs)
+    assert any("does not accept it" in m for m in msgs)  # build vs argparse
+    assert len(findings) == 4, "\n".join(f.render() for f in findings)
+
+
+def test_breaking_real_config_chain_is_caught(repo_files):
+    """Acceptance (real code): renaming a gen/server.py argparse flag out
+    from under its GenServerConfig chain."""
+    path = os.path.join(REPO, "areal_tpu", "gen", "server.py")
+    src = open(path).read()
+    assert '"--host-cache-mb"' in src
+    mutated = src.replace('"--host-cache-mb"', '"--host-cachemb"')
+    rel = os.path.join("areal_tpu", "gen", "server.py")
+    files = dict(repo_files)
+    files[rel] = SourceFile("server_mut", mutated, rel=rel)
+    findings = check_config_plumbing(files, REPO)
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("argparse has no '--host-cache-mb'" in m for m in msgs)
+    assert any("'--host-cachemb'" in m for m in msgs)  # now uncovered

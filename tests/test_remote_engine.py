@@ -329,3 +329,58 @@ def test_should_accept_filter(server):
         assert batch["input_ids"].shape[0] == 1
     finally:
         eng.destroy()
+
+
+def test_generation_payload_matches_server_contract():
+    """Regression (ISSUE 18 / C8 payload-contract): the client must ship
+    exactly the sampling keys gen/server.py::_req_from_body consumes —
+    `frequency_penalty` rode the wire unread for 17 PRs, silently implying
+    a sampler feature the JAX engine does not have."""
+    from areal_tpu.engine.jax_remote import JaxBackend
+
+    req = ModelRequest(
+        rid="contract-0",
+        input_ids=[1, 2, 3],
+        gconfig=GenerationHyperparameters(max_new_tokens=4),
+    )
+    http = JaxBackend().build_generation_request(req)
+    assert http.endpoint == "/generate"
+    assert set(http.payload["sampling_params"]) == {
+        "max_new_tokens", "min_new_tokens", "temperature",
+        "top_p", "top_k", "stop_token_ids",
+    }
+
+
+def test_fake_server_speaks_full_wire_contract(server):
+    """Regression (ISSUE 18 / C8): the fake must serve the real server's
+    key-sets — it omitted `output_versions` from /generate and
+    version/block/kv from /kv_export, hiding client drift from every
+    fake-backed test."""
+    import json
+    import urllib.request
+
+    from areal_tpu.gen import kv_pool
+
+    s, addr = server
+
+    def post(ep, payload):
+        req = urllib.request.Request(
+            f"http://{addr}{ep}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    r = post("/generate", {"rid": "wire-0", "input_ids": [1, 2, 3],
+                           "sampling_params": {"max_new_tokens": 4}})
+    assert r["output_tokens"]
+    # every token is stamped with the version that produced it
+    assert r["output_versions"] == [r["version"]] * len(r["output_tokens"])
+    # /kv_export must round-trip through the REAL wire decoder the router
+    # leg-2 import path uses
+    entry = kv_pool.wire_decode_entry(
+        post("/kv_export", {"input_ids": [1, 2, 3]})
+    )
+    assert entry["valid_len"] == 3
+    assert entry["version"] == s.version
+    assert list(entry["tokens"]) == [1, 2, 3]

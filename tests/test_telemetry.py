@@ -639,3 +639,16 @@ def test_events_dropped_total_on_all_three_surfaces(enabled):
             assert parsed[name][""] == before + 7
     finally:
         telemetry.EVENTS.dropped = before
+
+
+def test_partial_failure_counter_registered_eagerly():
+    """Regression (ISSUE 18 / C9 metric-contract): the control-plane
+    fanout partial-failure counter must be a module-level pinned metric —
+    the lazy per-failure construction left it off the scrape surface
+    until the first failure, unverifiable by the schema pin."""
+    text = telemetry.TRAIN.render_prometheus()
+    assert "areal_train_publish_partial_failures_total" in text
+    # get-or-create resolves to the same eagerly-registered instance
+    assert telemetry.PUBLISH_PARTIAL_FAILURES is telemetry.TRAIN.counter(
+        "publish_partial_failures_total"
+    )

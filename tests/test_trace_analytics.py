@@ -346,3 +346,27 @@ def test_check_slo_cli_against_checked_in_baseline():
          "--baseline", os.path.join(TRACES, "clean.jsonl")],
         capture_output=True, text=True, timeout=60)
     assert res.returncode == 2  # unusable baseline is its own failure
+
+
+def test_resubmit_cache_hit_consumed_not_orphaned():
+    """Regression (ISSUE 18 / C9 event-contract): core/remote.py emits
+    `resubmit_cache_hit` when a failover resubmit warm-starts through the
+    prefix cache; the trace parser dropped it on the floor, so the span
+    survived only as an unparsed line."""
+    evs = [
+        {"ts": 1.0, "mono": 1.0, "pid": 7, "event": "rollout_submit",
+         "trace_id": "rch", "input_len": 4},
+        {"ts": 1.1, "mono": 1.1, "pid": 7, "event": "resubmit",
+         "trace_id": "rch", "server": "b"},
+        {"ts": 1.15, "mono": 1.15, "pid": 7, "event": "resubmit_cache_hit",
+         "trace_id": "rch", "server": "b", "hit_tokens": 3},
+        {"ts": 1.5, "mono": 1.5, "pid": 7, "event": "gen_done",
+         "trace_id": "rch", "stop_reason": "stop", "output_len": 4,
+         "latency_s": 0.5, "attempts": 2},
+    ]
+    rep = analyze(evs)
+    assert rep.completeness.complete
+    (rec,) = rep.records
+    assert rec.resubmits == 1
+    assert rec.resubmit_cache_hits == 1
+    assert rec.resubmit_cache_hit_tokens == 3
