@@ -107,6 +107,16 @@ def compute_budgets(config: Dict[str, int]) -> Dict[str, int]:
         # nonzero rungs of the spec ladder mint verify signatures);
         # spec_rungs=0 (spec decode off) budgets zero verify programs
         "verify": tiers * ladder * config.get("spec_rungs", 0),
+        # ragged paged-decode attention (ISSUE 19): the collapsed
+        # grid-wide dispatch drops the tier factor entirely — one decode
+        # program per K bucket plus one verify program per (K bucket,
+        # nonzero D rung).  Page-count buckets add NO axis: the kernel's
+        # page size rides the prompt-bucket quantum, so each K bucket IS
+        # its page-count bucket (K/q pages, 1:1).  ragged=0 (flag off)
+        # budgets zero ragged programs.
+        "ragged_decode": ladder
+        * (1 + config.get("spec_rungs", 0))
+        * config.get("ragged", 0),
     }
 
 
@@ -158,6 +168,13 @@ def render_budget_doc(reference_configs: Dict[str, Dict[str, int]]) -> Dict:
             "verify": (
                 "decode_tiers * ladder * spec_rungs  (nonzero draft-length"
                 " rungs of the spec ladder; 0 when spec decode is off)"
+            ),
+            "ragged_decode": (
+                "ladder * (1 + spec_rungs) * ragged  (collapsed grid-wide"
+                " dispatch: the tier factor is gone, and page-count"
+                " buckets map 1:1 onto K buckets because the kernel page"
+                " size IS the prompt-bucket quantum; 0 when the ragged"
+                " flag is off)"
             ),
         },
         "reference_configs": {

@@ -278,6 +278,13 @@ class GenServerConfig:
     # explicitly so the capacity flag below is honored.
     host_offload: bool = False
     host_cache_mb: int = 64
+    # Ragged paged-decode attention (ISSUE 19): one fused Pallas kernel
+    # dispatch covers the whole slot grid (per-slot page spans through the
+    # KV page table), collapsing the per-tier decode/verify fan-out while
+    # keeping output streams bit-identical to the dense path.  The server
+    # auto-falls back to dense when the per-slot window exceeds the
+    # kernel's VMEM budget.
+    ragged_attn: bool = False
 
     @staticmethod
     def build_cmd(
@@ -326,6 +333,8 @@ class GenServerConfig:
                 )
             if config.spec_draft_len:
                 args.append(f"--spec-draft-len={config.spec_draft_len}")
+        if config.ragged_attn:
+            args.append("--ragged-attn")
         if port:
             args.append(f"--port={port}")
         return " ".join(args)

@@ -103,6 +103,7 @@ Design for XLA's static shapes:
 """
 
 # areal-lint: hot-path
+import functools
 import queue
 import threading
 import time
@@ -126,6 +127,7 @@ from areal_tpu.gen.spec import (
 from areal_tpu.gen.kv_pool import KVPool, lcp_ids
 from areal_tpu.models.model_config import TransformerConfig
 from areal_tpu.ops.kv_copy import gather_kv_prefix, scatter_kv_prefix
+from areal_tpu.ops.ragged_decode import ragged_supported
 from areal_tpu.models.transformer import (
     forward_decode,
     forward_prefill,
@@ -311,6 +313,7 @@ class GenEngine:
         host_offload: bool = False,
         host_cache_mb: int = 64,
         host_min_tokens: int = 32,
+        ragged_attn: bool = False,
     ):
         self.model_config = model_config.replace(remat=False)
         if params is None:
@@ -545,6 +548,36 @@ class GenEngine:
         # dispatch site's static arg is provably on the configured ladder
         # (areal-lint C6 value lattice: self.<attr> is engine config)
         self._spec_tier_d: Dict[int, int] = {}
+        # --- ragged paged-decode attention (ISSUE 19) -------------------
+        # When enabled AND the per-slot K/V working set fits the Pallas
+        # kernel's VMEM budget, every decode/verify step collapses to ONE
+        # grid-wide dispatch: the kernel gathers each slot's true page
+        # span through the page table, so tiers stop buying attended-cost
+        # separation and remain only as admission/migration placement
+        # policy.  The gate is evaluated ONCE here (worst case: the full
+        # max_seq_len window) so the dispatch site's static flag is an
+        # engine-lifetime attribute (areal-lint C6 value lattice).
+        self.ragged_attn = bool(ragged_attn)
+        self._ragged_ok = bool(
+            self.ragged_attn
+            and ragged_supported(
+                max_seq_len,
+                self.model_config.num_kv_heads,
+                self.model_config.head_dim_,
+                jnp.dtype(kv_dtype).itemsize,
+                tp=tp,
+            )
+        )
+        if self.ragged_attn and not self._ragged_ok:
+            logger.warning(
+                "ragged_attn requested but the %d-column K/V window "
+                "exceeds the kernel VMEM budget; falling back to the "
+                "dense tiered decode path",
+                max_seq_len,
+            )
+        # grid-wide D chosen for the CURRENT collapsed verify step — a
+        # self attr for the same C6 reason as _spec_tier_d
+        self._spec_grid_d = 0
         # weight version of the OLDEST K/V in each slot's valid prefix:
         # retained and shared prefixes propagate it, so strict-version
         # audits can prove no pre-swap KV seeds post-swap decoding
@@ -611,6 +644,14 @@ class GenEngine:
             "kv_handoff_imports": 0,
             "kv_handoff_bytes": 0,
             "kv_handoff_failures": 0,
+            # ragged paged-decode attention (ISSUE 19): collapsed
+            # grid-wide kernel dispatches, and the page-granular read
+            # accounting (pages the kernel actually gathered, summed over
+            # slots x steps).  The server mirrors both as
+            # areal_gen_ragged_*_total and derives the pages-per-dispatch
+            # gauge from their ratio.
+            "ragged_dispatches": 0,
+            "ragged_attended_pages": 0,
         }
 
         # decode_chunk: tokens generated per host round-trip.  The decode scan
@@ -621,6 +662,12 @@ class GenEngine:
         # which dominates when the chip is reached over a network tunnel.
         self.decode_chunk = max(1, decode_chunk)
         cfg = self.model_config
+        # ragged kernel closure constants: page granularity rides the SAME
+        # prompt-bucket ladder the key_window buckets on (so page-count
+        # buckets and K buckets are 1:1 — no extra signature axis), and
+        # tp>1 wraps the kernel in shard_map over the kv-head axis
+        _kernel_page = prompt_bucket
+        _kernel_mesh = self.mesh if tp > 1 else None
 
         def _stream_keys(decode_key, streams, pos):
             # counter-keyed sampling shared by every text prefill path:
@@ -666,6 +713,7 @@ class GenEngine:
         def _decode_chunk(
             params, cache, tokens, lengths, rope_pos, streams, active,
             temp, tp, tk, decode_key, rows, n, base, size, key_window,
+            ragged,
         ):
             """Advance ONE length-cohort tier — the `size` slots at
             logical positions [base, base+size) — by `n` fused
@@ -696,6 +744,8 @@ class GenEngine:
                     params, cfg, tok_b, len_b, cache,
                     rope_positions=rp_b, key_window=key_window,
                     slot_base=base, active=act_b, rows=rows_b,
+                    ragged=ragged, page_size=_kernel_page,
+                    mesh=_kernel_mesh,
                 )
                 # counter-based keys: (stream, cache position) — unique
                 # per generated token, independent of how the grid is
@@ -719,7 +769,7 @@ class GenEngine:
         def _verify_chunk(
             params, cache, tokens, lengths, rope_pos, streams, active,
             temp, tp, tk, decode_key, rows, drafts, draft_lens,
-            base, size, key_window, d_max,
+            base, size, key_window, d_max, ragged,
         ):
             """Speculative step for ONE tier: score the pending token plus
             up to `d_max` prompt-lookup drafts per slot in a single
@@ -747,7 +797,8 @@ class GenEngine:
                 params, cfg, inputs, len_b, cache,
                 rope_positions=rp_b, key_window=key_window,
                 slot_base=base, active=act_b, n_write=n_write,
-                rows=rows_b,
+                rows=rows_b, ragged=ragged, page_size=_kernel_page,
+                mesh=_kernel_mesh,
             )  # [size, Dp1, V]
             # position-keyed sampling: logits[:, j] is the distribution at
             # sequence position len + j, exactly the row a plain decode
@@ -826,6 +877,13 @@ class GenEngine:
         # family — the PR 16 cold-start re-mint — and silently degrading
         # the kv-head sharding under tp>1.
         rep = NamedSharding(self.mesh, P())
+        # _sync_device_state commits its uploads to this same replicated
+        # sharding: a bare jnp.asarray upload is an UNCOMMITTED
+        # SingleDeviceSharding, while chained chunk outputs carry `rep` —
+        # mixing the two mints a second executable per (static args)
+        # signature (the PR 16 cold-start re-mint class, caught again by
+        # the ragged soak's exact program accounting)
+        self._rep_sharding = rep
         cache_sh = {
             k: NamedSharding(self.mesh, self._cache_spec)
             for k in self.cache
@@ -849,7 +907,7 @@ class GenEngine:
         # programs and then mints none (pinned by test); the page-table
         # rows arg is traced data and adds no signatures
         self._decode_fn = jax.jit(
-            _decode_chunk, static_argnums=(12, 13, 14, 15),
+            _decode_chunk, static_argnums=(12, 13, 14, 15, 16),
             donate_argnums=(1, 2, 3, 4),
             out_shardings=(rep, cache_sh, rep, rep, rep),
         )
@@ -860,7 +918,7 @@ class GenEngine:
         # analysis/signature_budget.json ("verify") and pinned by the
         # jit-cache soak tests
         self._verify_fn = jax.jit(
-            _verify_chunk, static_argnums=(14, 15, 16, 17),
+            _verify_chunk, static_argnums=(14, 15, 16, 17, 18),
             donate_argnums=(1, 2, 3, 4),
             out_shardings=(rep, rep, cache_sh, rep, rep, rep),
         )
@@ -2526,22 +2584,129 @@ class GenEngine:
         active = np.asarray(
             [r is not None for r in self.slot_req], bool
         )
+        # uploads are COMMITTED to the replicated sharding the chunk
+        # programs emit: an uncommitted jnp.asarray here and a chained
+        # chunk output there would otherwise each mint their own
+        # executable per static signature (2x every decode/verify
+        # program — pinned by the ragged soak's exact accounting)
+        put = functools.partial(jax.device_put, device=self._rep_sharding)
         self._dev_state = {
-            "tokens": jnp.asarray(self.last_tokens),
-            "lengths": jnp.asarray(self.lengths),
-            "rope_pos": jnp.asarray(self.rope_pos),
-            "streams": jnp.asarray(self.stream_ids),
-            "active": jnp.asarray(active),
-            "temp": jnp.asarray(self.temperature),
-            "top_p": jnp.asarray(self.top_p),
-            "top_k": jnp.asarray(self.top_k),
+            "tokens": put(self.last_tokens),
+            "lengths": put(self.lengths),
+            "rope_pos": put(self.rope_pos),
+            "streams": put(self.stream_ids),
+            "active": put(active),
+            "temp": put(self.temperature),
+            "top_p": put(self.top_p),
+            "top_k": put(self.top_k),
             # page table: logical slot -> physical cache row (migration
             # remaps dirty the state, so this re-uploads exactly when it
             # changes and never per dispatch)
-            "rows": jnp.asarray(self.pool.page_table),
+            "rows": put(self.pool.device_rows()),
         }
         self._state_dirty = False
         self.stats["state_syncs"] += 1
+
+    def _dispatch_ragged(self, st, n, active, spec_plan) -> List[tuple]:
+        """ISSUE 19: advance the WHOLE slot grid in one fused ragged
+        dispatch.  The Pallas kernel gathers each slot's true page span
+        through the page table, so the per-tier dispatch fan-out (one
+        program per occupied length cohort) collapses into a single
+        program per step; tiers remain as admission/migration placement
+        policy but no longer cost a dispatch each.  When any tier drafted
+        this step, every slot rides ONE grid-wide verify at the largest
+        chosen D — draftless slots carry draft_lens=0 and emit exactly
+        their plain-decode token (the counter-keyed sampler makes the
+        stream partition-invariant, so collapsing dispatches cannot
+        change it).  Returns dev_outs entries for step()'s delivery loop
+        (tier label -1 = collapsed grid)."""
+        M = self.max_seq_len
+        page = self.prompt_bucket
+        span = int(max(self.lengths[s] for s in active))
+        lens = self.lengths[: self.n_slots].astype(np.int64)
+        if spec_plan:
+            d_grid = max(self._spec_tier_d[t] for t in spec_plan)
+            self._spec_grid_d = d_grid
+            drafts = np.zeros((self.n_slots, d_grid), np.int32)
+            dlens = np.zeros(self.n_slots, np.int32)
+            for t, (dr, dl) in spec_plan.items():
+                lo = self.tier_start[t]
+                hi = lo + self.tier_size[t]
+                drafts[lo:hi, : dr.shape[1]] = dr
+                dlens[lo:hi] = dl
+            if self.decode_window:
+                key_window = round_up_to_bucket(
+                    span + d_grid + 1, page, M
+                )
+            else:
+                key_window = M
+            out_t, nem_t, self.cache, tok, ln, rp = self._verify_fn(
+                self.params,
+                self.cache,
+                st["tokens"],
+                st["lengths"],
+                st["rope_pos"],
+                st["streams"],
+                st["active"],
+                st["temp"],
+                st["top_p"],
+                st["top_k"],
+                self._decode_key,
+                st["rows"],
+                jnp.asarray(drafts),
+                jnp.asarray(dlens),
+                0,
+                self.n_slots,
+                key_window,
+                self._spec_grid_d,
+                True,
+            )
+            st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
+            rows = d_grid + 1
+            self.stats["verify_calls"] += 1
+            self.stats["spec_drafted"] += int(dlens.sum())
+            attended = np.minimum(lens + rows, key_window)
+            pages = int(((attended + page - 1) // page).sum())
+            self.stats["ragged_dispatches"] += 1
+            self.stats["ragged_attended_pages"] += pages
+            # attended accounting is page-granular and PER SLOT — what
+            # the kernel actually read, not tier_size x key_window
+            self.stats["decode_attended_cols"] += pages * page
+            self.stats["decode_ceiling_cols"] += M * self.n_slots * rows
+            return [(-1, 0, self.n_slots, out_t, nem_t, rows, dlens)]
+        if self.decode_window:
+            key_window = round_up_to_bucket(span + n, page, M)
+        else:
+            key_window = M
+        out_t, self.cache, tok, ln, rp = self._decode_fn(
+            self.params,
+            self.cache,
+            st["tokens"],
+            st["lengths"],
+            st["rope_pos"],
+            st["streams"],
+            st["active"],
+            st["temp"],
+            st["top_p"],
+            st["top_k"],
+            self._decode_key,
+            st["rows"],
+            n,
+            0,
+            self.n_slots,
+            key_window,
+            True,
+        )
+        st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
+        self.stats["decode_calls"] += 1
+        steps = np.arange(1, n + 1, dtype=np.int64)[:, None]
+        attended = np.minimum(lens[None, :] + steps, key_window)
+        pages = int(((attended + page - 1) // page).sum())
+        self.stats["ragged_dispatches"] += 1
+        self.stats["ragged_attended_pages"] += pages
+        self.stats["decode_attended_cols"] += pages * page
+        self.stats["decode_ceiling_cols"] += M * self.n_slots * n
+        return [(-1, 0, self.n_slots, out_t, None, n, None)]
 
     def step(self, chunk: Optional[int] = None) -> int:
         """Admit pending prompts, then advance every active slot by up to
@@ -2642,11 +2807,18 @@ class GenEngine:
                 if tier_active[t]
             }
             t_dispatch = time.perf_counter()
-        # (tier, device out, device n_emit or None, out rows, draft lens)
+        # (tier label, block lo, block size, device out, device n_emit or
+        # None, out rows, draft lens); label -1 = collapsed ragged grid
         dev_outs: List[tuple] = []
         try:
+            if self._ragged_ok:
+                # ISSUE 19: one grid-wide ragged dispatch replaces the
+                # whole per-tier fan-out below
+                dev_outs.extend(
+                    self._dispatch_ragged(st, n, active, spec_plan)
+                )
             for t in range(self.n_tiers):
-                if not tier_active[t]:
+                if self._ragged_ok or not tier_active[t]:
                     continue
                 plan = spec_plan.get(t)
                 if plan is not None:
@@ -2684,6 +2856,7 @@ class GenEngine:
                         self.tier_size[t],
                         key_window,
                         self._spec_tier_d[t],
+                        False,
                     )
                     st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
                     rows = self._spec_tier_d[t] + 1
@@ -2695,7 +2868,10 @@ class GenEngine:
                     self.stats["decode_ceiling_cols"] += (
                         M * self.tier_size[t] * rows
                     )
-                    dev_outs.append((t, out_t, nem_t, rows, dlens))
+                    dev_outs.append((
+                        t, self.tier_start[t], self.tier_size[t],
+                        out_t, nem_t, rows, dlens,
+                    ))
                     continue
                 if self.decode_window:
                     span = int(max(self.lengths[s] for s in tier_active[t]))
@@ -2721,6 +2897,7 @@ class GenEngine:
                     self.tier_start[t],
                     self.tier_size[t],
                     key_window,
+                    False,
                 )
                 st["tokens"], st["lengths"], st["rope_pos"] = tok, ln, rp
                 self.stats["decode_calls"] += 1
@@ -2730,25 +2907,27 @@ class GenEngine:
                 self.stats["decode_ceiling_cols"] += (
                     M * self.tier_size[t] * n
                 )
-                dev_outs.append((t, out_t, None, n, None))
+                dev_outs.append((
+                    t, self.tier_start[t], self.tier_size[t],
+                    out_t, None, n, None,
+                ))
         except Exception:
             # a failed dispatch may have consumed (donated) device state
             with self._lock:
                 self._dev_state = None
                 self._state_dirty = True
             raise
-        nm = max(rows for _, _, _, rows, _ in dev_outs)
+        nm = max(rows for _, _, _, _, _, rows, _ in dev_outs)
         toks = np.zeros((nm, S), np.int32)
         logps = np.zeros((nm, S), np.float32)
         # per-slot usable token count: full chunk for decode tiers, the
         # accepted-run length (>= 1: the corrected token always emits) for
         # verify tiers — delivery masks everything beyond it
         avail = np.zeros(S, np.int64)
-        for t, out_t, nem_t, rows, dlens in dev_outs:
+        for t, lo, sz, out_t, nem_t, rows, dlens in dev_outs:
             # areal-lint: disable=host-sync delivery point: ONE fused download per tier chunk is the designed host round-trip cadence
-            arr = np.asarray(out_t)  # [2, rows, tier_size]
-            lo = self.tier_start[t]
-            hi = lo + self.tier_size[t]
+            arr = np.asarray(out_t)  # [2, rows, block size]
+            hi = lo + sz
             toks[:rows, lo:hi] = arr[0].astype(np.int32)
             logps[:rows, lo:hi] = arr[1]
             if nem_t is None:
@@ -2761,18 +2940,38 @@ class GenEngine:
                 drafted = int(dlens.sum())
                 accepted = int(np.maximum(nem - 1, 0).sum())
                 self.stats["spec_accepted"] += accepted
-                self._spec.record(t, drafted, accepted)
+                if t >= 0:
+                    self._spec.record(t, drafted, accepted)
+                else:
+                    # collapsed grid-wide verify (ISSUE 19): feed each
+                    # tier's acceptance controller its own slots' outcome
+                    # so the per-tier D ladder keeps adapting
+                    for tt in range(self.n_tiers):
+                        l2 = self.tier_start[tt] - lo
+                        h2 = l2 + self.tier_size[tt]
+                        d_tt = int(dlens[l2:h2].sum())
+                        if d_tt:
+                            self._spec.record(
+                                tt, d_tt,
+                                int(np.maximum(nem[l2:h2] - 1, 0).sum()),
+                            )
             if tele:
                 lat = time.perf_counter() - t_dispatch
                 telemetry.DECODE_CHUNK.observe(lat, tier=str(t))
+                n_act = len(active) if t < 0 else len(tier_active[t])
+                ids = (
+                    [i for v in tier_trace.values() for i in v]
+                    if t < 0
+                    else tier_trace.get(t, [])
+                )
                 if nem_t is None:
                     telemetry.emit(
                         "decode_chunk",
                         tier=t,
                         chunk=n,
-                        n_active=len(tier_active[t]),
+                        n_active=n_act,
                         latency_s=lat,
-                        trace_ids=tier_trace.get(t, []),
+                        trace_ids=ids,
                     )
                 else:
                     telemetry.emit(
@@ -2781,9 +2980,9 @@ class GenEngine:
                         draft_len=rows - 1,
                         drafted=drafted,
                         accepted=accepted,
-                        n_active=len(tier_active[t]),
+                        n_active=n_act,
                         latency_s=lat,
-                        trace_ids=tier_trace.get(t, []),
+                        trace_ids=ids,
                     )
 
         delivered = 0

@@ -353,6 +353,14 @@ class KVPool:
     def rows_of(self, slots) -> np.ndarray:
         return self.page_table[np.asarray(slots, np.int64)]
 
+    def device_rows(self) -> np.ndarray:
+        """Kernel-consumable snapshot of the page table: a contiguous
+        int32 copy (the ragged kernel scalar-prefetches it, and the
+        decode dispatches upload it as traced data).  A COPY, not a
+        view — the live table mutates under migration/free while an
+        uploaded snapshot must stay frozen until the next state sync."""
+        return np.ascontiguousarray(self.page_table, dtype=np.int32)
+
     def swap(self, a: int, b: int) -> None:
         """Remap two logical slots' physical rows (tier migration): the
         moving request's KV follows it with zero copies and the displaced

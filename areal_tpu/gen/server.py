@@ -149,6 +149,15 @@ class GenServer:
                 "prefix_cache_hit_rate",
                 "Admissions served from the radix/paged prefix cache",
             ).set(eng.prefix_cache_hit_rate())
+            # ragged paged-decode attention (ISSUE 19): mean KV pages the
+            # kernel gathered per collapsed dispatch; the raw counters
+            # ride the generic engine.stats mirror above
+            disp = float(eng.stats.get("ragged_dispatches", 0))
+            pages = float(eng.stats.get("ragged_attended_pages", 0))
+            reg.gauge(
+                "ragged_attended_pages",
+                "Mean KV pages gathered per ragged kernel dispatch",
+            ).set(pages / disp if disp else 0.0)
 
         reg.add_collector(_collect)
 
@@ -666,6 +675,11 @@ class GenServer:
                 "kv_handoff_imports": _stat("kv_handoff_imports"),
                 "kv_handoff_bytes": _stat("kv_handoff_bytes"),
                 "kv_handoff_failures": _stat("kv_handoff_failures"),
+                # ragged paged-decode attention (ISSUE 19): collapsed
+                # grid-wide kernel dispatches and the page-granular read
+                # ledger (pages actually gathered, slots x steps)
+                "ragged_dispatches": _stat("ragged_dispatches"),
+                "ragged_attended_pages": _stat("ragged_attended_pages"),
             }
         )
 
@@ -754,6 +768,14 @@ def main():
     p.add_argument("--spec-draft-len", type=int, default=0,
                    help="pin the draft length instead of adapting along "
                         "the ladder (benches/tests)")
+    p.add_argument("--ragged-attn", action="store_true",
+                   help="fused ragged paged-decode attention (ISSUE 19): "
+                        "one Pallas kernel dispatch covers the whole slot "
+                        "grid (per-slot page spans via the KV page table), "
+                        "collapsing the per-tier decode/verify fan-out; "
+                        "output streams stay bit-identical to the dense "
+                        "path (auto-falls back when the per-slot window "
+                        "exceeds the kernel VMEM budget)")
     p.add_argument("--role", choices=("prefill", "decode", "both"),
                    default="both",
                    help="disaggregated-fleet role advertised to the "
@@ -798,6 +820,7 @@ def main():
         spec_draft_len=args.spec_draft_len or None,
         host_offload=args.host_offload,
         host_cache_mb=args.host_cache_mb,
+        ragged_attn=args.ragged_attn,
     )
     if args.model_path:
         cfg = TransformerConfig.from_hf(args.model_path)

@@ -117,8 +117,10 @@ class TransformerConfig:
     remat: bool = True  # jax.checkpoint each layer
     # "full": recompute everything in backward (min HBM);
     # "dots": save matmul outputs, recompute elementwise only — trades HBM
-    # for ~the forward matmul FLOPs of the backward recompute
-    remat_policy: str = "full"  # full | dots
+    # for ~the forward matmul FLOPs of the backward recompute;
+    # "save_attn"/"save_mlp": keep only the tagged attention/MLP outputs
+    # (checkpoint_name in _layer_forward) — the selective rungs between
+    remat_policy: str = "full"  # full | dots | save_attn | save_mlp
     # layer-scan unroll factor: >1 trades compile time for less per-layer
     # scan overhead (dynamic-update-slice carry traffic); must divide
     # num_layers to take effect

@@ -37,6 +37,7 @@ from areal_tpu.ops.attention import (  # noqa: F401 — re-exported for gen path
     segment_attention,
     splash_supported,
 )
+from areal_tpu.ops.ragged_decode import ragged_paged_attention
 
 Params = Dict[str, Any]
 
@@ -209,6 +210,7 @@ def _layer_forward(
     x = x + attn_delta
     h = _norm(cfg, x, lp, "post_attn_norm")
     ffn_out, aux = _ffn(cfg, lp, h, dtype)
+    ffn_out = jax.ad_checkpoint.checkpoint_name(ffn_out, "mlp_out")
     if cfg.sandwich_norms:
         ffn_out = _norm(cfg, ffn_out, lp, "sandwich_ffn_norm")
     return x + ffn_out, aux
@@ -308,12 +310,23 @@ def _backbone(
                     "attn_out"
                 ),
             )
+        elif cfg.remat_policy == "save_mlp":
+            # keep each layer's MLP output instead (ROADMAP 3b probe): the
+            # backward pass recomputes attention but not the MLP — the
+            # rung between save_attn and full on the memory/recompute
+            # ladder, aimed at the backward-scan carry plateau
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "mlp_out"
+                ),
+            )
         elif cfg.remat_policy == "full":
             layer_fn = jax.checkpoint(layer_fn)
         else:
             raise ValueError(
                 f"unknown remat_policy {cfg.remat_policy!r}; use 'full', "
-                "'save_attn', or 'dots'"
+                "'save_attn', 'save_mlp', or 'dots'"
             )
 
     def scan_body(carry, xs):
@@ -696,6 +709,9 @@ def forward_decode(
     slot_base: int = 0,  # STATIC first cache row of the dispatched block
     active: Optional[jax.Array] = None,  # bool [B]; False drops the KV write
     rows: Optional[jax.Array] = None,  # int32 [B] physical rows (page table)
+    ragged: bool = False,  # STATIC: fused ragged paged-attention kernel
+    page_size: int = 0,  # STATIC page granularity for the ragged path
+    mesh: Optional[Mesh] = None,  # tp>1 shard_map wrap for the kernel
 ):
     """One decode step for a block of `B` slots; returns (logits [B, V],
     new cache).  The new token's K/V is written at cache position
@@ -770,21 +786,41 @@ def forward_decode(
         if cfg.pos_emb == "rope":
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-        ck = ck.at[slots, widx].set(k[:, 0].astype(ck.dtype), mode="drop")
-        cv = cv.at[slots, widx].set(v[:, 0].astype(cv.dtype), mode="drop")
-        # read only the block's rows and the attended window [0, K): the
-        # cache keeps its full [S_total, M] shape, attention never touches
-        # rows outside the tier or columns past the window
-        if rows is None:
-            ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
-            cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        if ragged and rows is not None:
+            # fused ragged kernel: append write + per-slot paged read +
+            # exact dense-order softmax in ONE program over the grid
+            # (bit-identical to the set/take/attention sequence below —
+            # ops/ragged_decode.py pins the exactness argument)
+            attn, ck, cv = ragged_paged_attention(
+                q, k.astype(ck.dtype), v.astype(cv.dtype), ck, cv,
+                rows, lengths, widx[:, None], m[:, 0],
+                key_window=K, page_size=page_size,
+                logit_softcap=cfg.attn_logit_softcap, mesh=mesh,
+            )
         else:
-            ckr = jnp.take(ck, rows, axis=0)
-            cvr = jnp.take(cv, rows, axis=0)
-        attn = attention(
-            q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
-            cfg.attn_logit_softcap,
-        )
+            ck = ck.at[slots, widx].set(
+                k[:, 0].astype(ck.dtype), mode="drop"
+            )
+            cv = cv.at[slots, widx].set(
+                v[:, 0].astype(cv.dtype), mode="drop"
+            )
+            # read only the block's rows and the attended window [0, K):
+            # the cache keeps its full [S_total, M] shape, attention never
+            # touches rows outside the tier or columns past the window
+            if rows is None:
+                ckr = jax.lax.slice_in_dim(
+                    ck, slot_base, slot_base + B, axis=0
+                )
+                cvr = jax.lax.slice_in_dim(
+                    cv, slot_base, slot_base + B, axis=0
+                )
+            else:
+                ckr = jnp.take(ck, rows, axis=0)
+                cvr = jnp.take(cv, rows, axis=0)
+            attn = attention(
+                q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
+                cfg.attn_logit_softcap,
+            )
         delta = _proj(
             cfg, lp["attn"], "wo", attn.reshape(B, 1, cfg.q_size), dtype,
             bias="bo",
@@ -821,6 +857,9 @@ def forward_verify(
     active: Optional[jax.Array] = None,  # bool [B]; False drops ALL KV writes
     n_write: Optional[jax.Array] = None,  # int32 [B] valid input positions
     rows: Optional[jax.Array] = None,  # int32 [B] physical rows (page table)
+    ragged: bool = False,  # STATIC: fused ragged paged-attention kernel
+    page_size: int = 0,  # STATIC page granularity for the ragged path
+    mesh: Optional[Mesh] = None,  # tp>1 shard_map wrap for the kernel
 ):
     """Speculative-decode verification: score T input positions per slot of
     a contiguous tier block in ONE dispatch — the decode analogue of
@@ -891,18 +930,36 @@ def forward_verify(
         if cfg.pos_emb == "rope":
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-        ck = ck.at[slots[:, None], widx].set(k.astype(ck.dtype), mode="drop")
-        cv = cv.at[slots[:, None], widx].set(v.astype(cv.dtype), mode="drop")
-        if rows is None:
-            ckr = jax.lax.slice_in_dim(ck, slot_base, slot_base + B, axis=0)
-            cvr = jax.lax.slice_in_dim(cv, slot_base, slot_base + B, axis=0)
+        if ragged and rows is not None:
+            # same fused kernel as decode with a T-wide query tile: draft
+            # verification rides the paged read for free (ISSUE 19)
+            attn, ck, cv = ragged_paged_attention(
+                q, k.astype(ck.dtype), v.astype(cv.dtype), ck, cv,
+                rows, lengths, widx, m[:, 0],
+                key_window=K, page_size=page_size,
+                logit_softcap=cfg.attn_logit_softcap, mesh=mesh,
+            )
         else:
-            ckr = jnp.take(ck, rows, axis=0)
-            cvr = jnp.take(cv, rows, axis=0)
-        attn = attention(
-            q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
-            cfg.attn_logit_softcap,
-        )
+            ck = ck.at[slots[:, None], widx].set(
+                k.astype(ck.dtype), mode="drop"
+            )
+            cv = cv.at[slots[:, None], widx].set(
+                v.astype(cv.dtype), mode="drop"
+            )
+            if rows is None:
+                ckr = jax.lax.slice_in_dim(
+                    ck, slot_base, slot_base + B, axis=0
+                )
+                cvr = jax.lax.slice_in_dim(
+                    cv, slot_base, slot_base + B, axis=0
+                )
+            else:
+                ckr = jnp.take(ck, rows, axis=0)
+                cvr = jnp.take(cv, rows, axis=0)
+            attn = attention(
+                q, ckr[:, :K].astype(dtype), cvr[:, :K].astype(dtype), m,
+                cfg.attn_logit_softcap,
+            )
         delta = _proj(
             cfg, lp["attn"], "wo", attn.reshape(B, T, cfg.q_size), dtype,
             bias="bo",

@@ -49,6 +49,22 @@ except Exception:  # pragma: no cover
 MASK_VALUE = -2.3819763e38
 
 
+@jax.custom_jvp
+def _pin(x: jax.Array) -> jax.Array:
+    """Identity that lowers to `lax.optimization_barrier`, with a pass-through
+    tangent: the barrier has no differentiation rule on the installed jaxlib,
+    and the bit-identity contract it protects (see `naive_attention`) only
+    covers the inference forward — training gradients flow through the
+    unbarriered graph unchanged."""
+    return jax.lax.optimization_barrier(x)
+
+
+@_pin.defjvp
+def _pin_jvp(primals, tangents):
+    (x,), (t,) = primals, tangents
+    return jax.lax.optimization_barrier(x), t
+
+
 def _shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
     """`jax.shard_map` became a top-level API only recently; older jaxlibs
     (0.4.x) ship it as `jax.experimental.shard_map.shard_map` with the
@@ -115,7 +131,15 @@ def naive_attention(
     scores = jnp.einsum("btkgh,bskh->bkgts", q, k).astype(jnp.float32)
     scores *= 1.0 / np.sqrt(hd)
     if logit_softcap:
+        # barrier-pinned: XLA's algebraic simplifier merges the scale /
+        # softcap constants differently depending on the surrounding
+        # graph, which breaks the bit-identity contract between this
+        # dense path and the ragged Pallas kernel (ops/ragged_decode.py
+        # pins the same literal sequence).  The barriers force the
+        # written div/tanh/mul order in every compilation context.
+        scores = _pin(scores)
         scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+        scores = _pin(scores)
     mask = mask[:, :, None, :, :] if mask.ndim == 4 else mask  # [B,1,1,T,S]
     scores = jnp.where(mask, scores, MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -1,0 +1,326 @@
+"""Ragged paged-decode attention: one Pallas program per slot grid.
+
+Role counterpart of vLLM's PagedAttention / SGLang's ragged decode kernels
+(PAPERS.md; SNIPPETS [1] shows the `pallas_call` + `shard_map` idiom this
+module follows).  The dense decode path (`models/transformer.py
+forward_decode`) pays three XLA ops per layer — a scatter append, a
+row gather, and a [B, K] bucketed matmul over the tier's FULL K bucket —
+for every slot, long or short.  This kernel fuses all three into one
+program over the slot grid and makes the KV *read* ragged: each slot DMAs
+only the `ceil((length + T) / page)` pages its occupied span covers out of
+its page-table row, so HBM traffic tracks per-slot occupancy instead of
+the cohort ceiling, and the per-tier dispatch fan-out collapses to a
+single program (`gen/engine.py step`).
+
+Generalised query tile: `T = 1` is plain decode; `T = D + 1` scores the
+pending token plus D speculative drafts in the same kernel — verification
+rides the decode read for free, which is what lets the engine collapse
+decode + verify into one dispatch per step.
+
+Exactness discipline (docs/perf.md Round 13): the kernel is BIT-IDENTICAL
+to the dense bucketed path, not merely close.  A classic online-softmax
+accumulation (rescale by exp(m_old - m_new) per visiting page) cannot be —
+its division/rescale order differs from `jax.nn.softmax` — so the kernel
+instead gathers the occupied pages into a zero-filled VMEM scratch of the
+static K-bucket width and then applies the EXACT op sequence of
+`ops/attention.py naive_attention` (einsum -> f32 -> scale -> softcap ->
+mask -> softmax -> einsum).  Masked tail columns carry exact-zero softmax
+mass (exp(MASK_VALUE - max) underflows to 0.0) and the zero-filled pages
+contribute exact zeros to the output contraction, so the page-windowed
+result equals the full-bucket result bit-for-bit — the same width-
+invariance the dense windowed path already relies on.  The bandwidth win
+survives: reads drop from K to the occupied span; only the compute shape
+stays at K.
+
+The K/V append write is fused in: new keys/values are DMA'd into the
+slot's page-table row at its write positions (index M = scatter-drop,
+mirroring the dense path's idle-slot/overflow clamp) and overlaid into
+the scratch before the compute, reproducing the dense write-then-read
+order exactly.
+
+`INTERPRET` (or any non-TPU backend) runs the SAME kernel through the
+Pallas interpreter, so CPU tier-1 tests and benches exercise the real
+program, not a shadow implementation.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.ops.attention import MASK_VALUE, _shard_map
+
+# Tests may force interpret mode explicitly; any non-TPU backend always
+# interprets (the kernel is the only decode path when ragged_attn is on,
+# so CPU runs must execute it rather than fail to lower).
+INTERPRET = False
+
+# VMEM budget for the per-slot K/V scratch (two [K, Hkv, hd] buffers).
+# Half the ~16 MB/core so the q/out blocks and the surrounding layer's
+# weight tiles keep headroom; engines whose worst-case bucket would
+# overflow this fall back to the dense path at init (gen/engine.py).
+RAGGED_VMEM_BYTES = 8 << 20
+
+
+def _interpret_mode(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return INTERPRET or jax.default_backend() != "tpu"
+
+
+def ragged_supported(
+    max_key_window: int,
+    num_kv_heads: int,
+    head_dim: int,
+    kv_itemsize: int,
+    tp: int = 1,
+) -> bool:
+    """Static gate for enabling the ragged path on an engine: the worst-
+    case (max bucket) K/V scratch for one slot must fit the VMEM budget.
+    Evaluated once at engine init so the dispatch-site flag is
+    engine-lifetime config (areal-lint C6 value lattice)."""
+    hkv = max(1, num_kv_heads // max(1, tp))
+    scratch = 2 * max_key_window * hkv * head_dim * kv_itemsize
+    return scratch <= RAGGED_VMEM_BYTES
+
+
+def _kernel(
+    # scalar prefetch (SMEM)
+    rows_ref,  # int32 [B] physical cache row per slot (page table)
+    npages_ref,  # int32 [B] full pages the slot's span covers
+    tail_ref,  # int32 [B] 1 -> also copy the static tail (K % page != 0)
+    widx_ref,  # int32 [B, T] write positions; M = scatter-drop
+    # blocked inputs (VMEM)
+    q_ref,  # [1, T, Hq, hd] compute dtype
+    kn_ref,  # [1, T, Hkv, hd] kv dtype (pre-cast: write-then-read order)
+    vn_ref,  # [1, T, Hkv, hd]
+    mask_ref,  # uint8 [1, T, K] attended-position mask
+    ck_hbm,  # [S, M, Hkv, hd] ANY — full cache, read via DMA
+    cv_hbm,
+    # outputs
+    out_ref,  # [1, T, Hq, hd]
+    ck_out,  # aliased with ck_hbm (in-place append)
+    cv_out,
+    # scratch
+    ks_ref,  # VMEM [K, Hkv, hd] kv dtype
+    vs_ref,
+    sem,
+    *,
+    T: int,
+    K: int,
+    M: int,
+    page: int,
+    group: int,
+    hd: int,
+    logit_softcap: Optional[float],
+):
+    i = pl.program_id(0)
+    row = rows_ref[i]
+    npg = npages_ref[i]
+    n_full = K // page
+    tail = K - n_full * page
+
+    # zero-fill, then gather ONLY the slot's occupied pages over it: the
+    # untouched tail pages contribute exact zeros downstream, which is
+    # what makes the page-windowed softmax bit-equal to the dense bucket
+    ks_ref[...] = jnp.zeros_like(ks_ref)
+    vs_ref[...] = jnp.zeros_like(vs_ref)
+
+    def copy_page(p, _):
+        for hbm, scr in ((ck_out, ks_ref), (cv_out, vs_ref)):
+            cp = pltpu.make_async_copy(
+                hbm.at[row, pl.ds(p * page, page)],
+                scr.at[pl.ds(p * page, page)],
+                sem,
+            )
+            cp.start()
+            cp.wait()
+        return 0
+
+    jax.lax.fori_loop(0, npg, copy_page, 0)
+    if tail:
+        # K not page-aligned (key_window == max_seq_len off the pow2
+        # ladder): the remainder is a STATIC slice, copied when the span
+        # reaches past the last full page
+        @pl.when(tail_ref[i] > 0)
+        def _():
+            for hbm, scr in ((ck_out, ks_ref), (cv_out, vs_ref)):
+                cp = pltpu.make_async_copy(
+                    hbm.at[row, pl.ds(n_full * page, tail)],
+                    scr.at[pl.ds(n_full * page, tail)],
+                    sem,
+                )
+                cp.start()
+                cp.wait()
+
+    # fused append: the new K/V lands in the page-table row (HBM) AND is
+    # overlaid into the scratch — the dense path's write-then-read order.
+    # widx == M is the dense scatter-drop sentinel (idle slot / padding
+    # position of a short draft): neither write happens.
+    for t in range(T):
+        wi = widx_ref[i, t]
+
+        @pl.when(wi < M)
+        def _():
+            ks_ref[pl.ds(wi, 1)] = kn_ref[0, pl.ds(t, 1)]
+            vs_ref[pl.ds(wi, 1)] = vn_ref[0, pl.ds(t, 1)]
+            for hbm, new in ((ck_out, kn_ref), (cv_out, vn_ref)):
+                cp = pltpu.make_async_copy(
+                    new.at[0, pl.ds(t, 1)],
+                    hbm.at[row, pl.ds(wi, 1)],
+                    sem,
+                )
+                cp.start()
+                cp.wait()
+
+    # EXACT naive_attention op order (ops/attention.py) — any deviation
+    # here breaks the bit-identity contract the parity tests pin
+    dtype = q_ref.dtype
+    qs = q_ref[0].reshape(T, ks_ref.shape[1], group, hd)
+    ks = ks_ref[...].astype(dtype)
+    vs = vs_ref[...].astype(dtype)
+    scores = jnp.einsum("tkgh,skh->kgts", qs, ks).astype(jnp.float32)
+    scores *= 1.0 / np.sqrt(hd)
+    if logit_softcap:
+        # barrier-pinned to match naive_attention exactly — see the
+        # twin comment there (the simplifier otherwise merges the
+        # scale/softcap constants differently per compilation context)
+        scores = jax.lax.optimization_barrier(scores)
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+        scores = jax.lax.optimization_barrier(scores)
+    m = mask_ref[0][None, None] != 0  # [1, 1, T, K]
+    scores = jnp.where(m, scores, MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("kgts,skh->tkgh", probs.astype(vs.dtype), vs)
+    out_ref[0] = out.reshape(T, group * ks_ref.shape[1], hd)
+
+
+def _ragged_call(
+    q, k_new, v_new, ck, cv, rows, npages, tail, widx, mask,
+    *, K: int, page: int, logit_softcap: Optional[float], interpret: bool,
+):
+    B, T, Hq, hd = q.shape
+    Hkv = ck.shape[2]
+    M = ck.shape[1]
+    kernel = functools.partial(
+        _kernel,
+        T=T, K=K, M=M, page=page, group=Hq // Hkv, hd=hd,
+        logit_softcap=logit_softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, T, Hq, hd), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec((1, T, Hkv, hd), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec((1, T, Hkv, hd), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec((1, T, K), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, T, Hq, hd), lambda i, *_: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, Hkv, hd), ck.dtype),
+            pltpu.VMEM((K, Hkv, hd), cv.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, Hq, hd), q.dtype),
+            jax.ShapeDtypeStruct(ck.shape, ck.dtype),
+            jax.ShapeDtypeStruct(cv.shape, cv.dtype),
+        ],
+        # operand indices INCLUDE the scalar-prefetch args: q=4 ... ck=8,
+        # cv=9; the cache updates in place (the dense path's donated-scan
+        # analogue)
+        input_output_aliases={8: 1, 9: 2},
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ) if not interpret else None,
+    )
+    return fn(rows, npages, tail, widx, q, k_new, v_new, mask, ck, cv)
+
+
+def ragged_paged_attention(
+    q: jax.Array,  # [B, T, Hq, hd] compute dtype (rope already applied)
+    k_new: jax.Array,  # [B, T, Hkv, hd] kv dtype (caller casts — the
+    v_new: jax.Array,  # dense path rounds through the cache dtype too)
+    ck: jax.Array,  # [S_total, M, Hkv, hd] cache keys (one layer)
+    cv: jax.Array,
+    rows: jax.Array,  # int32 [B] physical row per slot (page table)
+    lengths: jax.Array,  # int32 [B] cache fill per slot
+    widx: jax.Array,  # int32 [B, T] write positions; M = drop
+    mask: jax.Array,  # bool [B, T, K] attended cache positions
+    *,
+    key_window: int,  # STATIC bucketed compute width (K)
+    page_size: int,  # STATIC page granularity (the prompt-bucket quantum)
+    logit_softcap: Optional[float] = None,
+    mesh: Optional[Mesh] = None,  # tp>1: shard kv heads via shard_map
+    interpret: Optional[bool] = None,
+):
+    """Fused ragged decode/verify attention for one layer of a slot grid.
+
+    Returns `(attn_out [B, T, Hq, hd], ck, cv)` with the new K/V appended
+    into the cache — bit-identical to the dense sequence
+    ``set -> take -> naive_attention`` over the same `key_window`, while
+    reading only each slot's occupied pages.  `T = 1` is decode; `T > 1`
+    is speculative verification (same program family, wider query tile).
+    """
+    B, T = q.shape[:2]
+    M = ck.shape[1]
+    K = min(key_window, M)
+    page = min(page_size, K)
+    n_full = K // page
+    # span covers every attended/written position: cache cols [0, len + T)
+    span = jnp.minimum(lengths + T, K)
+    npages = jnp.minimum((span + page - 1) // page, n_full).astype(jnp.int32)
+    tail = (span > n_full * page).astype(jnp.int32)
+    mask_u8 = mask.astype(jnp.uint8)
+    interp = _interpret_mode(interpret)
+    call = functools.partial(
+        _ragged_call, K=K, page=page, logit_softcap=logit_softcap,
+        interpret=interp,
+    )
+    if mesh is None or mesh.shape.get("tp", 1) <= 1:
+        return call(
+            q, k_new, v_new, ck, cv, rows, npages, tail, widx, mask_u8
+        )
+    # tp>1 serving path (SNIPPETS [1] pattern): kv heads ride the mesh's
+    # tp axis exactly as the engine's cache sharding lays them out; q
+    # heads are kv-major so the same split keeps each query group with
+    # its kv head.  Per-shard compute is the identical op sequence, so
+    # bit-identity holds shard-locally and the concat restores the dense
+    # layout.
+    kvs = P(None, None, "tp", None)
+    return _shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(
+            kvs,  # q [B, T, Hq, hd] — kv-major head split
+            kvs,  # k_new
+            kvs,  # v_new
+            kvs,  # ck [S, M, Hkv, hd]
+            kvs,  # cv
+            P(None),  # rows
+            P(None),  # npages
+            P(None),  # tail
+            P(None, None),  # widx
+            P(None, None, None),  # mask
+        ),
+        out_specs=(kvs, kvs, kvs),
+        check_vma=False,
+    )(q, k_new, v_new, ck, cv, rows, npages, tail, widx, mask_u8)

@@ -204,6 +204,10 @@ def main():
     # larger batches hit HBM pressure); smaller fallbacks for smaller chips
     ladder = [
         (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_attn"),
+        # ROADMAP 3b plateau probe: keep MLP intermediates instead of the
+        # attention outputs — the intermediate memory/recompute rung
+        # between save_attn and full, aimed at the backward-scan carry
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_mlp"),
         (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "full"),
         (qwen25_1p5b(), "qwen25_1p5b", 4, 2048, 1, "full"),
         (qwen25_1p5b(), "qwen25_1p5b", 2, 2048, 1, "full"),
@@ -221,7 +225,7 @@ def main():
         # transient remote_compile HTTP 500s used to forfeit the save_attn
         # rung for the whole round (BENCH_r05: one 500 -> full remat
         # headline); the upper rung gets ONE retry before falling back
-        tries = 2 if policy == "save_attn" else 1
+        tries = 2 if policy in ("save_attn", "save_mlp") else 1
         for attempt in range(1, tries + 1):
             try:
                 result = _run(model_cfg, name, n_rows, row_len, n_mbs,
@@ -417,6 +421,21 @@ def _serving_probe():
     spec = bs.bench_spec_decode_ab(cfg, params, n_slots=8, gen_tokens=128)
     out["serving_spec_acceptance_rate"] = spec["on"]["spec_acceptance_rate"]
     out["serving_spec_decode_speedup"] = spec["spec_over_plain_tok_s"]
+    # ragged paged-decode kernel (ISSUE 19): dispatch collapse + tok/s
+    # ratio on the mixed-length workload, with the stream-parity bit
+    # riding along (False would mean the kernel broke bit-identity);
+    # on CPU the kernel interprets, so the tok/s ratio carries the chip
+    # caveat while the dispatch reduction transfers as-is
+    ragged = bs.bench_ragged_ab(cfg, params, n_slots=8, gen_tokens=96)
+    for regime in ("mixed", "repetition"):
+        r = ragged[regime]
+        out[f"serving_ragged_speedup_{regime}"] = r["ragged_over_dense_tok_s"]
+        out[f"serving_ragged_dispatch_reduction_{regime}"] = (
+            r["dispatch_reduction"]
+        )
+        out[f"serving_ragged_bit_identical_{regime}"] = (
+            r["streams_bit_identical"]
+        )
     return out
 
 

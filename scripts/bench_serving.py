@@ -535,6 +535,110 @@ def bench_spec_decode_ab(cfg, params, n_slots=8, prompt_len=64,
     return out
 
 
+def bench_ragged_ab(cfg, params, n_slots=8, gen_tokens=96, max_seq_len=512,
+                    draft_len=31):
+    """ISSUE 19 acceptance A/B: the SAME workload through the dense tiered
+    decode path and the collapsed ragged-kernel path, on two regimes:
+
+      - mixed:      mixed-length random prompts, greedy, spec off — the
+                    ragged-span case (per-slot paged gather vs the dense
+                    tier ceiling), one grid-wide dispatch per step vs one
+                    per active tier.
+      - repetition: repetition-heavy continuation-of-own-output prompts
+                    with speculative decoding on — verification rides the
+                    SAME kernel (T = D+1 query positions), so the per-tier
+                    verify fan-out collapses too.
+
+    The correctness contract rides along with the perf number: token AND
+    logprob streams must be bit-identical across arms, and the acceptance
+    bar is a strict decode+verify dispatch-count reduction at equal
+    streams.  On the CPU rig the kernel runs in Pallas interpret mode —
+    dispatch counts, attended-page accounting, and bit-identity all
+    transfer to real chips, wall-clock ratios do NOT (interpret-mode
+    per-dispatch overhead dominates; see docs/perf.md Round 13)."""
+    from areal_tpu.gen.engine import GenRequest
+
+    out = {"n_slots": n_slots, "gen_tokens": gen_tokens,
+           "interpret_caveat": (
+               "CPU run: kernel in Pallas interpret mode; dispatch counts "
+               "and bit-identity transfer to chips, wall-clock does not")}
+    rng = np.random.default_rng(17)
+
+    mixed_prompts = [
+        rng.integers(0, cfg.vocab_size, int(n)).tolist()
+        for n in rng.integers(16, 257, n_slots)
+    ]
+    rep_params = _repetition_params(cfg, params)
+    seeds = rng.integers(0, cfg.vocab_size, n_slots).tolist()
+    seed_eng = _engine(cfg, rep_params, n_slots, max_seq_len, kv_reuse=False)
+    seed_reqs = [
+        GenRequest(rid=f"s{i}", input_ids=[int(s)], max_new_tokens=63,
+                   temperature=0.0)
+        for i, s in enumerate(seeds)
+    ]
+    seed_eng.generate_blocking(seed_reqs)
+    rep_prompts = [[int(s)] + list(r.output_tokens)
+                   for s, r in zip(seeds, seed_reqs)]
+    del seed_eng
+
+    regimes = {
+        "mixed": dict(params=params, prompts=mixed_prompts, kw={}),
+        "repetition": dict(
+            params=rep_params, prompts=rep_prompts,
+            kw=dict(spec_decode=True, spec_draft_len=draft_len or None)),
+    }
+    for name, regime in regimes.items():
+        streams, res = {}, {}
+        for mode in ("dense", "ragged"):
+            eng = _engine(cfg, regime["params"], n_slots, max_seq_len,
+                          kv_reuse=False, decode_tiers=2,
+                          ragged_attn=(mode == "ragged"), **regime["kw"])
+            warm = [
+                GenRequest(rid=f"w{i}", input_ids=list(p),
+                           max_new_tokens=gen_tokens, temperature=0.0)
+                for i, p in enumerate(regime["prompts"])
+            ]
+            eng.generate_blocking(warm)
+            _reset_stats(eng)
+            eng.retained_len[:] = 0
+            reqs = [
+                GenRequest(rid=f"m{i}", input_ids=list(p),
+                           max_new_tokens=gen_tokens, temperature=0.0)
+                for i, p in enumerate(regime["prompts"])
+            ]
+            for r in reqs:
+                eng.submit(r)
+            eng.step()  # admission (prefill) outside the decode timing
+            t0 = time.perf_counter()
+            delivered = 0
+            while any(not r.stop_reason for r in reqs):
+                delivered += eng.step()
+            dt = time.perf_counter() - t0
+            streams[mode] = [(tuple(r.output_tokens),
+                              tuple(r.output_logprobs)) for r in reqs]
+            res[mode] = {
+                "tokens_per_sec": round(delivered / dt, 1),
+                "wall_s": round(dt, 2),
+                "decode_calls": eng.stats["decode_calls"],
+                "verify_calls": eng.stats["verify_calls"],
+                "ragged_dispatches": eng.stats["ragged_dispatches"],
+                "ragged_attended_pages": eng.stats["ragged_attended_pages"],
+            }
+            print(f"ragged_ab {name}/{mode}: {res[mode]}", file=sys.stderr,
+                  flush=True)
+            del eng
+        res["streams_bit_identical"] = streams["dense"] == streams["ragged"]
+        dn, rg = res["dense"], res["ragged"]
+        res["dispatches_dense"] = dn["decode_calls"] + dn["verify_calls"]
+        res["dispatches_ragged"] = rg["decode_calls"] + rg["verify_calls"]
+        res["dispatch_reduction"] = round(
+            1 - res["dispatches_ragged"] / max(1, res["dispatches_dense"]), 4)
+        res["ragged_over_dense_tok_s"] = round(
+            rg["tokens_per_sec"] / max(dn["tokens_per_sec"], 1e-9), 3)
+        out[name] = res
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--slots", default="8,32,64,128,256")
@@ -566,6 +670,15 @@ def main():
                         "(ISSUE 12 acceptance: >= 1.4x decode tok/s on CPU)")
     p.add_argument("--spec-slots", type=int, default=8)
     p.add_argument("--spec-gen", type=int, default=128)
+    # ragged paged-decode kernel A/B (ISSUE 19 acceptance)
+    p.add_argument("--ab-ragged", action="store_true",
+                   help="ragged-vs-dense decode A/B on the mixed-length "
+                        "and repetition workloads (ISSUE 19 acceptance: "
+                        "bit-identical streams, strictly fewer "
+                        "decode+verify dispatches; CPU numbers run the "
+                        "kernel in Pallas interpret mode)")
+    p.add_argument("--ragged-slots", type=int, default=8)
+    p.add_argument("--ragged-gen", type=int, default=96)
     # group fan-out regime knobs (GRPO-shaped grouped admission)
     p.add_argument("--group-size", type=int, default=8)
     p.add_argument("--group-prompt", type=int, default=256)
@@ -622,6 +735,11 @@ def main():
         result["spec_ab"] = bench_spec_decode_ab(
             cfg, params, n_slots=args.spec_slots,
             gen_tokens=args.spec_gen, draft_len=args.draft_len,
+        )
+    if args.ab_ragged:
+        result["ragged_ab"] = bench_ragged_ab(
+            cfg, params, n_slots=args.ragged_slots,
+            gen_tokens=args.ragged_gen, draft_len=args.draft_len,
         )
     if not args.skip_ceiling_ab:
         result["decode_ceiling_ab"] = bench_decode_ceiling_ab(

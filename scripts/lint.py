@@ -61,6 +61,17 @@ REFERENCE_CONFIGS = {
         "decode_tiers": 2,
         "spec_rungs": 2,
     },
+    # ISSUE 19: ragged kernel on — the collapsed grid-wide dispatch
+    # budgets one decode program per K bucket and one verify program per
+    # (K bucket, nonzero D rung); the tier factor is gone by design
+    "ragged_decode_soak": {
+        "n_slots": 4,
+        "max_seq_len": 256,
+        "prompt_bucket": 16,
+        "decode_tiers": 2,
+        "spec_rungs": 2,
+        "ragged": 1,
+    },
 }
 
 

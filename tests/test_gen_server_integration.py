@@ -534,3 +534,51 @@ def test_stats_key_miss_is_counted_not_silent(live_server):
     finally:
         if removed is not None:
             engine.stats["copy_calls"] = removed
+
+
+def test_ragged_telemetry_on_scrape_surface():
+    """ISSUE 19 satellite: a ragged-enabled server exposes the kernel's
+    dispatch counter and the attended-pages gauge on BOTH scrape surfaces
+    (legacy JSON and Prometheus) after real decode traffic, with names
+    pinned in tests/data/metrics_schema.json."""
+    import json
+    import urllib.request
+
+    import jax
+
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    engine = GenEngine(CFG, params=params, n_slots=4, max_seq_len=96,
+                       prompt_bucket=16, ragged_attn=True)
+    assert engine._ragged_ok
+    _, addr, stop = _boot_server(engine)
+    try:
+        client = _client(addr)
+        try:
+            resp = asyncio.run(client.agenerate(ModelRequest(
+                input_ids=[5, 6, 7],
+                gconfig=GenerationHyperparameters(max_new_tokens=8,
+                                                  greedy=True),
+            )))
+            assert len(resp.output_tokens) == 8
+        finally:
+            client.destroy()
+
+        legacy = json.loads(urllib.request.urlopen(
+            f"http://{addr}/metrics", timeout=10).read())
+        assert legacy["ragged_dispatches"] > 0
+        assert legacy["ragged_attended_pages"] > 0
+        prom = urllib.request.urlopen(
+            f"http://{addr}/metrics?format=prometheus", timeout=10
+        ).read().decode()
+        scraped = {
+            ln.split()[0]: float(ln.split()[-1])
+            for ln in prom.splitlines()
+            if ln and not ln.startswith("#")
+        }
+        assert scraped.get("areal_gen_ragged_dispatches_total", 0) > 0
+        assert scraped.get("areal_gen_ragged_attended_pages_total", 0) > 0
+        # mean pages gathered per dispatch — the kernel's work metric
+        assert "areal_gen_ragged_attended_pages" in scraped
+        assert scraped["areal_gen_ragged_attended_pages"] > 0
+    finally:
+        stop()

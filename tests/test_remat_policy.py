@@ -26,7 +26,7 @@ def test_dots_policy_matches_full():
         return jax.value_and_grad(f)(params)
 
     l_full, g_full = loss(base.replace(remat=True, remat_policy="full"))
-    for policy in ("dots", "save_attn"):
+    for policy in ("dots", "save_attn", "save_mlp"):
         l_p, g_p = loss(base.replace(remat=True, remat_policy=policy))
         np.testing.assert_allclose(float(l_full), float(l_p), rtol=1e-6)
         jax.tree_util.tree_map(
