@@ -85,6 +85,14 @@ def compute_budgets(config: Dict[str, int]) -> Dict[str, int]:
     static-arg combinations x shape buckets x the x2 sharding family
     (cold device_put vs decode-output resident arrays).  Soak tests
     assert observed `_cache_size()` <= these."""
+    if "train_shapes" in config:
+        # train-step cache (engine/jax_train.py _train_step_cache): one
+        # program per (loss_fn, n_mbs, row_len, padded_len) signature.
+        # The reference soak drives exactly `train_shapes` distinct
+        # signatures; the two-level layer scan (layer_group_size), remat
+        # rung, and scan unroll are engine-lifetime config baked into the
+        # traced program — they must add NO signature axis.
+        return {"train_step": config["train_shapes"]}
     q = config["prompt_bucket"]
     m = config["max_seq_len"]
     slots = config["n_slots"]
@@ -175,6 +183,12 @@ def render_budget_doc(reference_configs: Dict[str, Dict[str, int]]) -> Dict:
                 " buckets map 1:1 onto K buckets because the kernel page"
                 " size IS the prompt-bucket quantum; 0 when the ragged"
                 " flag is off)"
+            ),
+            "train_step": (
+                "train_shapes  (distinct (loss_fn, n_mbs, row_len,"
+                " padded_len) signatures the soak drives;"
+                " layer_group_size / remat rung / scan unroll are"
+                " engine-lifetime config and add NO axis)"
             ),
         },
         "reference_configs": {

@@ -247,6 +247,9 @@ class WireContracts:
             for u in met.get("unpinned", [])
         }
         self.config_chains: Dict[str, Any] = doc.get("config_chains", {})
+        self.train_config_chains: Dict[str, Any] = doc.get(
+            "train_config_chains", {}
+        )
 
     @classmethod
     def load(cls, root: str) -> "WireContracts":
@@ -1228,6 +1231,135 @@ def check_config_plumbing(
 
 
 # --------------------------------------------------------------------------
+# C10 (train half): TrainEngineConfig -> bench flag -> model-config replace
+# --------------------------------------------------------------------------
+
+def _class_ann_fields(
+    sf: SourceFile, cls_name: str
+) -> Tuple[Dict[str, int], int]:
+    fields: Dict[str, int] = {}
+    cls_line = 1
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            cls_line = node.lineno
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    fields[item.target.id] = item.lineno
+    return fields, cls_line
+
+
+def check_train_config_plumbing(
+    files: Dict[str, SourceFile],
+    root: Optional[str] = None,
+    contracts: Optional[WireContracts] = None,
+) -> List[Finding]:
+    """The train-side config chains (ISSUE 20): each declared
+    TrainEngineConfig knob must (a) exist as a config field, (b) be
+    exposed AND read by the e2e bench's argparse when a flag is declared,
+    and (c) — when it steers the backbone — exist on TransformerConfig
+    and be plumbed through a `.replace(` call in the train engine.  Unlike
+    the GenServer chain this is a DECLARED-chains check, not an
+    exhaustive-coverage sweep: TrainEngineConfig has dozens of fields with
+    their own plumbing idioms; the registry lists the chains whose silent
+    breakage has bitten (a flag parsed but dropped trains a different
+    model than the artifact records)."""
+    wc = contracts or WireContracts.load(root)
+    tc = wc.train_config_chains
+    if not tc:
+        return []
+    f = tc.get("files", {})
+    cfg_sf = files.get(os.path.normpath(f.get("config", "")))
+    bench_sf = files.get(os.path.normpath(f.get("bench", "")))
+    model_sf = files.get(os.path.normpath(f.get("model_config", "")))
+    eng_sf = files.get(os.path.normpath(f.get("engine", "")))
+    if cfg_sf is None or bench_sf is None or model_sf is None \
+            or eng_sf is None:
+        return [Finding(
+            RULE_REGISTRY, CONTRACTS_PATH, 1,
+            f"train_config_chains.files points at missing files "
+            f"({sorted(f.values())})",
+        )]
+
+    cfg_fields, cfg_line = _class_ann_fields(
+        cfg_sf, f.get("config_class", "TrainEngineConfig"))
+    model_fields, _ = _class_ann_fields(
+        model_sf, f.get("model_class", "TransformerConfig"))
+
+    # bench argparse flags + `args.<dest>` reads (a parsed-but-never-read
+    # flag silently trains the default)
+    bench_flags: Dict[str, int] = {}
+    args_reads: Dict[str, int] = {}
+    for node in ast.walk(bench_sf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args):
+            flag = _const_str(node.args[0])
+            if flag and flag.startswith("--"):
+                bench_flags.setdefault(flag, node.lineno)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "args"):
+            args_reads.setdefault(node.attr, node.lineno)
+
+    # model-config kwargs the engine plumbs via `.replace(...)`
+    replace_kwargs: Dict[str, int] = {}
+    for node in ast.walk(eng_sf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "replace"):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    replace_kwargs.setdefault(kw.arg, node.lineno)
+
+    findings: List[Finding] = []
+    for chain in tc.get("chains", []):
+        field = chain.get("field")
+        flag = chain.get("flag")
+        mfield = chain.get("model_field")
+        label = field or flag or mfield
+        if field and field not in cfg_fields:
+            findings.append(apply_suppression(cfg_sf, Finding(
+                RULE_CONFIG, cfg_sf.rel, cfg_line,
+                f"train chain '{label}': TrainEngineConfig has no field "
+                f"'{field}' (renamed without updating "
+                f"wire_contracts.json?)",
+            )))
+        if flag:
+            if flag not in bench_flags:
+                findings.append(apply_suppression(bench_sf, Finding(
+                    RULE_CONFIG, bench_sf.rel, 1,
+                    f"train chain '{label}': {bench_sf.rel} argparse has "
+                    f"no '{flag}' flag",
+                )))
+            else:
+                dest = flag.lstrip("-").replace("-", "_")
+                if dest not in args_reads:
+                    findings.append(apply_suppression(bench_sf, Finding(
+                        RULE_CONFIG, bench_sf.rel, bench_flags[flag],
+                        f"train chain '{label}': '{flag}' is parsed but "
+                        f"`args.{dest}` is never read — the flag is "
+                        f"silently dropped",
+                    )))
+        if mfield:
+            if mfield not in model_fields:
+                findings.append(apply_suppression(model_sf, Finding(
+                    RULE_CONFIG, model_sf.rel, 1,
+                    f"train chain '{label}': TransformerConfig has no "
+                    f"field '{mfield}'",
+                )))
+            if mfield not in replace_kwargs:
+                findings.append(apply_suppression(eng_sf, Finding(
+                    RULE_CONFIG, eng_sf.rel, 1,
+                    f"train chain '{label}': {eng_sf.rel} never plumbs "
+                    f"'{mfield}' into a model-config .replace(...) — the "
+                    f"engine knob cannot reach the backbone",
+                )))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # suite entry point
 # --------------------------------------------------------------------------
 
@@ -1245,4 +1377,5 @@ def check_wire_contracts(
     findings.extend(check_payload_contracts(files, root, contracts=wc))
     findings.extend(check_telemetry_contracts(files, root, contracts=wc))
     findings.extend(check_config_plumbing(files, root, contracts=wc))
+    findings.extend(check_train_config_plumbing(files, root, contracts=wc))
     return findings

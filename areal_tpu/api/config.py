@@ -98,11 +98,25 @@ class TrainEngineConfig:
     disable_dropout: bool = True
     gradient_checkpointing: bool = True
     # "full" recomputes layers in backward (min HBM); "dots" keeps matmul
-    # outputs (faster when HBM allows — v5p-class chips)
+    # outputs (faster when HBM allows — v5p-class chips); "save_attn"/
+    # "save_mlp" keep only the tagged attention/MLP outputs;
+    # "carry_offload" keeps both tags but parks them in pinned host memory
+    # (models/model_config.py TransformerConfig.remat_policy)
     remat_policy: str = "full"
-    # layer-scan unroll: >1 cuts per-layer scan overhead (~2% throughput at
-    # 4 on v5e 1.5B) for more compile time/live buffers; must divide depth
+    # two-level layer scan (models/transformer.py): the outer scan runs
+    # num_layers/G steps, each an unrolled chain of G layers behind ONE
+    # remat boundary — saved carries shrink ~G×.  Must divide the model
+    # depth (rejected loudly); 1 = the classic per-layer scan
+    layer_group_size: int = 1
+    # outer-scan unroll: >1 cuts per-step scan overhead (~2% throughput at
+    # 4 on v5e 1.5B) for more compile time/live buffers; must divide the
+    # outer scan length (num_layers / layer_group_size) — non-divisors
+    # warn loudly and fall back to 1; the effective value rides train stats
     scan_unroll: int = 1
+    # fused LM-head vocab chunk width (ops/fused_xent.py), rounded up to a
+    # multiple of 128; 0 = the AREAL_LM_HEAD_CHUNK env default (8192).
+    # Plumbed through the loss partial so the bench ladder can sweep it
+    lm_head_chunk: int = 0
     mb_spec: "MicroBatchSpec" = field(default_factory=lambda: MicroBatchSpec())
     optimizer: Optional[OptimizerConfig] = field(default_factory=OptimizerConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)

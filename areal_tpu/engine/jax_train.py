@@ -188,6 +188,7 @@ class JaxTrainEngine(TrainEngine):
             remat=cfg.gradient_checkpointing,
             remat_policy=getattr(cfg, "remat_policy", "full"),
             scan_unroll=getattr(cfg, "scan_unroll", 1),
+            layer_group_size=getattr(cfg, "layer_group_size", 1),
             # an explicitly-set model config wins; the engine config is the
             # yaml-reachable path for checkpoints (from_hf leaves "auto")
             attn_impl=(
@@ -196,6 +197,21 @@ class JaxTrainEngine(TrainEngine):
                 else getattr(cfg, "attn_impl", "auto")
             ),
         )
+        # fail the two-level scan contracts HERE, before any tracing: a
+        # non-divisor group size inside jit surfaces as a trace error deep
+        # in the first train step otherwise.  effective_scan_unroll warns
+        # loudly on a non-divisor unroll and falls back to 1; the value it
+        # settles on rides every train-stats dict so a silently forfeited
+        # unroll is visible in logged artifacts, not just stderr.
+        mc_ = self.model_config
+        if mc_.num_layers % max(1, mc_.layer_group_size):
+            raise ValueError(
+                f"layer_group_size={mc_.layer_group_size} must divide "
+                f"num_layers={mc_.num_layers}"
+            )
+        from areal_tpu.models.transformer import effective_scan_unroll
+
+        self._effective_scan_unroll = effective_scan_unroll(mc_)
         if getattr(cfg, "lora", None) is not None and cfg.lora.enabled:
             from areal_tpu.models.lora import add_lora_params
 
@@ -572,6 +588,20 @@ class JaxTrainEngine(TrainEngine):
             )
         return input_
 
+    def _scan_stats(self) -> Dict[str, float]:
+        """Layer-scan configuration evidence for every stats dict: the
+        group size actually compiled and the unroll the scan actually used
+        (a non-divisor scan_unroll falls back to 1 with a warning — this
+        keeps the fallback visible in logged artifacts too)."""
+        return {
+            "layer_group_size": float(
+                max(1, self.model_config.layer_group_size)
+            ),
+            "effective_scan_unroll": float(
+                getattr(self, "_effective_scan_unroll", 1)
+            ),
+        }
+
     def train_batch(
         self,
         input_: Dict[str, np.ndarray],
@@ -620,6 +650,7 @@ class JaxTrainEngine(TrainEngine):
             )
             def _finish(st: Dict[str, float]) -> Dict[str, float]:
                 st = {**st, "total_loss_weight": total_weight}
+                st.update(self._scan_stats())
                 if telemetry.is_enabled():
                     telemetry.publish_train_stats(st)
                 return st
@@ -632,6 +663,7 @@ class JaxTrainEngine(TrainEngine):
             k: float(v) for k, v in distributed.fetch_replicated(stats).items()
         }
         stats["total_loss_weight"] = total_weight
+        stats.update(self._scan_stats())
         stats["step_time"] = time.perf_counter() - t0
         # per-chip MFU from the analytic flops model (the role of the
         # reference's flops_counter + kineto categorisation, monitor.py:404)
@@ -1103,10 +1135,25 @@ class JaxTrainEngine(TrainEngine):
             param_dtype=self.config.param_dtype,
             remat=self.config.gradient_checkpointing,
             remat_policy=getattr(self.config, "remat_policy", "full"),
+            scan_unroll=getattr(self.config, "scan_unroll", 1),
+            layer_group_size=getattr(self.config, "layer_group_size", 1),
             lora_rank=self.model_config.lora_rank if lora_on else 0,
             lora_alpha=self.model_config.lora_alpha,
             lora_targets=self.model_config.lora_targets if lora_on else (),
         )
+        # the checkpoint may carry a different depth: re-apply the
+        # grouped-scan contracts against the loaded num_layers
+        if self.model_config.num_layers % max(
+            1, self.model_config.layer_group_size
+        ):
+            raise ValueError(
+                f"layer_group_size={self.model_config.layer_group_size} "
+                f"must divide the loaded checkpoint's "
+                f"num_layers={self.model_config.num_layers}"
+            )
+        from areal_tpu.models.transformer import effective_scan_unroll
+
+        self._effective_scan_unroll = effective_scan_unroll(self.model_config)
         if lora_on:
             from areal_tpu.models.lora import add_lora_params
 

@@ -101,6 +101,7 @@ class PPOActor:
         the engine the SAME object."""
         if not hasattr(self, "_logp_hook"):
             temp = self.config.temperature
+            vchunk = getattr(self.config, "lm_head_chunk", 0) or None
 
             def hook(model_out, mb):
                 import jax.numpy as jnp
@@ -109,7 +110,8 @@ class PPOActor:
 
                 labels = jnp.roll(mb["input_ids"], -1, axis=-1)
                 logp, _, _ = lm_logprobs_entropy(
-                    model_out, labels, temperature=temp, with_entropy=False
+                    model_out, labels, temperature=temp, with_entropy=False,
+                    vocab_chunk=vchunk,
                 )
                 return logp
 
@@ -329,6 +331,10 @@ class PPOActor:
             temperature=cfg.temperature,
             use_decoupled_loss=cfg.use_decoupled_loss,
             eps_clip_higher=cfg.eps_clip_higher,
+            # plumbed fused-head chunk width (0/unset -> env default);
+            # baked into the partial so the bench ladder's sweep value
+            # reaches the compiled step, not just the config dataclass
+            vocab_chunk=getattr(cfg, "lm_head_chunk", 0) or None,
         )
 
     def _train_one_mb(self, mb: Dict[str, np.ndarray]):

@@ -114,16 +114,31 @@ class TransformerConfig:
     # numerics
     dtype: str = "bfloat16"  # compute/activation dtype
     param_dtype: str = "float32"  # master weights
-    remat: bool = True  # jax.checkpoint each layer
+    remat: bool = True  # jax.checkpoint each layer (or layer group)
     # "full": recompute everything in backward (min HBM);
     # "dots": save matmul outputs, recompute elementwise only — trades HBM
     # for ~the forward matmul FLOPs of the backward recompute;
     # "save_attn"/"save_mlp": keep only the tagged attention/MLP outputs
-    # (checkpoint_name in _layer_forward) — the selective rungs between
-    remat_policy: str = "full"  # full | dots | save_attn | save_mlp
-    # layer-scan unroll factor: >1 trades compile time for less per-layer
-    # scan overhead (dynamic-update-slice carry traffic); must divide
-    # num_layers to take effect
+    # (checkpoint_name in _layer_forward) — the selective rungs between;
+    # "carry_offload": save the tagged attention AND MLP outputs but park
+    # them in pinned host memory (save_and_offload_only_these_names) —
+    # trades the HBM pressure that kills selective rungs at long context
+    # for PCIe/host traffic the backward overlaps with recompute
+    remat_policy: str = "full"  # full | dots | save_attn | save_mlp | carry_offload
+    # two-level layer scan: the outer lax.scan runs num_layers /
+    # layer_group_size steps, each step an unrolled chain of
+    # layer_group_size layers wrapped in ONE jax.checkpoint at the group
+    # boundary.  Only inter-group activations are saved (within-group ones
+    # are recomputed), so the backward scan-transpose carry shrinks ~G× in
+    # entry count — the sqrt-remat regime a per-layer checkpoint cannot
+    # express.  Must divide num_layers (rejected loudly otherwise); 1
+    # reproduces the classic per-layer scan exactly.
+    layer_group_size: int = 1
+    # outer-scan unroll factor: >1 trades compile time for less per-step
+    # scan overhead (dynamic-update-slice carry traffic); must divide the
+    # outer scan length (num_layers / layer_group_size) — non-divisors
+    # warn loudly and fall back to 1 (models/transformer.py
+    # effective_scan_unroll)
     scan_unroll: int = 1
     # lax.scan(_split_transpose=...): split the backward (transposed) layer
     # scan into two passes — XLA can then overlap the grad-accumulation

@@ -216,6 +216,76 @@ def _layer_forward(
     return x + ffn_out, aux
 
 
+def _remat_checkpoint_kwargs(cfg: TransformerConfig) -> dict:
+    """jax.checkpoint kwargs for the config's remat rung.  Applied around
+    one layer (layer_group_size == 1) or one unrolled group of layers — the
+    policy composes per checkpoint boundary either way."""
+    if cfg.remat_policy == "dots":
+        return dict(
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if cfg.remat_policy == "save_attn":
+        # keep the tagged attention outputs (checkpoint_name in
+        # _layer_forward): the backward pass recomputes projections and
+        # MLP but not the attention kernel — ~50 MB/layer at 16k tokens,
+        # the selective policy that still fits 16G v5e
+        return dict(
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out")
+        )
+    if cfg.remat_policy == "save_mlp":
+        # keep the tagged MLP outputs instead (ROADMAP 3b probe): the
+        # backward pass recomputes attention but not the MLP — the rung
+        # between save_attn and full on the memory/recompute ladder
+        return dict(
+            policy=jax.checkpoint_policies.save_only_these_names("mlp_out")
+        )
+    if cfg.remat_policy == "carry_offload":
+        # keep BOTH tagged outputs but park them in pinned host memory:
+        # the residuals leave HBM entirely, trading the pressure that
+        # kills save_attn compiles for PCIe traffic the backward can
+        # overlap with recompute.  Requires a runtime with host memory
+        # spaces (TPU); CPU test rigs may fail to lower — the bench
+        # ladder records the per-rung compile outcome either way.
+        return dict(
+            policy=jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["attn_out", "mlp_out"],
+                offload_src="device",
+                offload_dst="pinned_host",
+            )
+        )
+    if cfg.remat_policy == "full":
+        return {}
+    raise ValueError(
+        f"unknown remat_policy {cfg.remat_policy!r}; use 'full', "
+        "'save_attn', 'save_mlp', 'carry_offload', or 'dots'"
+    )
+
+
+def effective_scan_unroll(cfg: TransformerConfig) -> int:
+    """The unroll factor the layer scan will actually use.
+
+    `scan_unroll` must divide the OUTER scan length (num_layers /
+    layer_group_size).  Non-divisors fall back to 1 — loudly: the silent
+    fallback this replaces let a mistuned config quietly forfeit the
+    unrolling win for whole rounds.  Engines record this value in train
+    stats / bench JSON so the regression is visible in artifacts too."""
+    u = max(1, cfg.scan_unroll)
+    n = cfg.num_layers // max(1, cfg.layer_group_size)
+    if n % u:
+        import warnings
+
+        warnings.warn(
+            f"scan_unroll={cfg.scan_unroll} does not divide the outer layer-"
+            f"scan length {n} (num_layers={cfg.num_layers}, "
+            f"layer_group_size={cfg.layer_group_size}); falling back to "
+            "unroll=1 — pick a divisor to get the requested unrolling",
+            stacklevel=2,
+        )
+        return 1
+    return u
+
+
 def _backbone(
     params: Params,
     cfg: TransformerConfig,
@@ -293,57 +363,73 @@ def _backbone(
         mask = make_attention_mask(segment_ids, positions, cfg.sliding_window)
 
     layer_fn = functools.partial(_layer_forward, cfg, mesh)
-    if cfg.remat:
-        if cfg.remat_policy == "dots":
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            )
-        elif cfg.remat_policy == "save_attn":
-            # keep each layer's attention output (checkpoint_name tag in
-            # _layer_forward): the backward pass recomputes projections and
-            # MLP but not the attention kernel — ~50 MB/layer at 16k tokens,
-            # the selective policy that still fits 16G v5e
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "attn_out"
-                ),
-            )
-        elif cfg.remat_policy == "save_mlp":
-            # keep each layer's MLP output instead (ROADMAP 3b probe): the
-            # backward pass recomputes attention but not the MLP — the
-            # rung between save_attn and full on the memory/recompute
-            # ladder, aimed at the backward-scan carry plateau
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.save_only_these_names(
-                    "mlp_out"
-                ),
-            )
-        elif cfg.remat_policy == "full":
-            layer_fn = jax.checkpoint(layer_fn)
-        else:
-            raise ValueError(
-                f"unknown remat_policy {cfg.remat_policy!r}; use 'full', "
-                "'save_attn', 'save_mlp', or 'dots'"
-            )
+    ckpt_kwargs = _remat_checkpoint_kwargs(cfg) if cfg.remat else None
 
-    def scan_body(carry, xs):
-        lp, sliding = xs
-        x, aux_sum = carry
+    G = max(1, cfg.layer_group_size)
+    if cfg.num_layers % G:
+        raise ValueError(
+            f"layer_group_size={cfg.layer_group_size} must divide "
+            f"num_layers={cfg.num_layers}: a trailing partial group would "
+            "silently change the remat boundary — pick a divisor"
+        )
+    n_groups = cfg.num_layers // G
+
+    def one_layer(lp, sliding, x):
         m = mask
         if mask_win is not None:
             m = jnp.where(sliding, mask_win, mask)
-        x, aux = layer_fn(lp, x, cos, sin, segment_ids, positions, m)
-        return (x, aux_sum + aux), None
+        return layer_fn(lp, x, cos, sin, segment_ids, positions, m)
 
-    unroll = cfg.scan_unroll if cfg.num_layers % max(cfg.scan_unroll, 1) == 0 else 1
+    if G == 1:
+        # classic single-level scan; the remat policy wraps each layer
+        if ckpt_kwargs is not None:
+            layer_fn = jax.checkpoint(layer_fn, **ckpt_kwargs)
+
+        def scan_body(carry, xs):
+            lp, sliding = xs
+            x, aux_sum = carry
+            x, aux = one_layer(lp, sliding, x)
+            return (x, aux_sum + aux), None
+
+        xs = (params["layers"], _layer_sliding_flags(cfg))
+    else:
+        # two-level scan: the outer scan runs n_groups steps, each an
+        # unrolled chain of G layers behind ONE checkpoint at the group
+        # boundary.  Only the inter-group activation is saved (everything
+        # inside the group is recomputed under `full`, or kept per the
+        # selective policy), so the backward scan-transpose carry holds
+        # n_groups entries instead of num_layers — ~G× fewer
+        # dynamic-update-slice carry writes.
+        def group_fn(gp, gflags, x):
+            aux = jnp.zeros((), jnp.float32)
+            for i in range(G):
+                lp = jax.tree_util.tree_map(lambda a, i=i: a[i], gp)
+                x, a = one_layer(lp, gflags[i], x)
+                aux = aux + a
+            return x, aux
+
+        if ckpt_kwargs is not None:
+            group_fn = jax.checkpoint(group_fn, **ckpt_kwargs)
+
+        def scan_body(carry, xs):
+            gp, gflags = xs
+            x, aux_sum = carry
+            x, aux = group_fn(gp, gflags, x)
+            return (x, aux_sum + aux), None
+
+        xs = (
+            jax.tree_util.tree_map(
+                lambda a: a.reshape((n_groups, G) + a.shape[1:]),
+                params["layers"],
+            ),
+            _layer_sliding_flags(cfg).reshape(n_groups, G),
+        )
+
     (x, aux), _ = jax.lax.scan(
         scan_body,
         (x, jnp.zeros((), jnp.float32)),
-        (params["layers"], _layer_sliding_flags(cfg)),
-        unroll=max(1, unroll),
+        xs,
+        unroll=effective_scan_unroll(cfg),
         _split_transpose=cfg.scan_split_transpose,
     )
     return _norm(cfg, x, params, "final_norm"), aux

@@ -100,6 +100,9 @@ def lm_logprobs_entropy(
     entropy_clamp: float = 0.0,
     entropy_grad: bool = True,
     impl: Optional[str] = None,  # fused | chunked; None -> env or "fused"
+    vocab_chunk: Optional[int] = None,  # fused-head chunk width; None ->
+    # AREAL_LM_HEAD_CHUNK env or 8192 (TrainEngineConfig.lm_head_chunk is
+    # the plumbed spelling — loss partials pass it through here)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(logprobs, entropy, argmax-correct) of `labels`, fp32 numerics.
 
@@ -146,7 +149,10 @@ def lm_logprobs_entropy(
             out.head,
             labels.reshape(-1),
             temperature=temperature,
-            vocab_chunk=int(_os.environ.get("AREAL_LM_HEAD_CHUNK", 8192)),
+            vocab_chunk=int(
+                vocab_chunk
+                or _os.environ.get("AREAL_LM_HEAD_CHUNK", 8192)
+            ),
             with_entropy=with_entropy,
             entropy_grad=entropy_grad,
         )
@@ -285,6 +291,7 @@ def grpo_loss_fn(
     use_decoupled_loss: bool = True,
     entropy_coef: float = 0.0,
     eps_clip_higher: Optional[float] = None,
+    vocab_chunk: Optional[int] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Packed GRPO/PPO policy loss over next-token logits
     (reference: areal/engine/ppo/actor.py:313-391 grpo_loss_fn).
@@ -300,6 +307,7 @@ def grpo_loss_fn(
         # on it — skipping its backward term saves an elementwise pass over
         # every recomputed logits block
         entropy_grad=bool(entropy_coef),
+        vocab_chunk=vocab_chunk,
     )
     old_logp = batch["logprobs"]
     prox = batch.get("prox_logp") if use_decoupled_loss else None
@@ -358,14 +366,15 @@ def ppo_critic_loss_fn(
 
 
 def sft_loss_fn(
-    model_out, batch: Dict[str, jax.Array]
+    model_out, batch: Dict[str, jax.Array],
+    vocab_chunk: Optional[int] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Token cross-entropy over next-token targets, masked sum
     (reference: areal/engine/sft/lm_engine.py)."""
     labels = jnp.roll(batch["input_ids"], -1, axis=-1)
     mask = batch["loss_mask"].astype(jnp.float32)
     logprobs, _, correct = lm_logprobs_entropy(
-        model_out, labels, entropy_grad=False
+        model_out, labels, entropy_grad=False, vocab_chunk=vocab_chunk
     )
     loss = -jnp.sum(logprobs * mask)
     aux = getattr(model_out, "aux_loss", None)

@@ -69,7 +69,8 @@ def _make_batch(rng, n_rows, row_len, vocab, seqs_per_row=2):
 
 
 def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2,
-         group_size=2, remat_policy="save_attn"):
+         group_size=2, remat_policy="save_attn", layer_group_size=1,
+         lm_head_chunk=0):
     import jax
 
     from areal_tpu.api.config import (
@@ -96,9 +97,18 @@ def _run(model_cfg, model_name, n_rows, row_len, n_mbs=1, seqs_per_row=2,
         # buys ~1% over full remat; the ladder falls back to "full" if the
         # borderline fit flakes
         remat_policy=remat_policy,
-        # unroll 4 layers per scan iteration: less per-layer carry traffic
-        # (~2% on v5e); 7+ runs out of HBM
-        scan_unroll=4,
+        # two-level scan (ISSUE 20): >1 groups this many layers behind one
+        # remat boundary per outer-scan step — the backward scan-transpose
+        # carry shrinks ~G×; must divide the model depth
+        layer_group_size=layer_group_size,
+        # fused LM-head vocab chunk (0 = env default 8192); the sweep
+        # below records the neighbouring widths
+        lm_head_chunk=lm_head_chunk,
+        # unroll 4 outer-scan steps per iteration: less per-step carry
+        # traffic (~2% on v5e); 7+ runs out of HBM.  With grouping the
+        # outer length is depth/G — non-divisors would loudly fall back
+        # to 1, so grouped rungs pin unroll=1 instead
+        scan_unroll=4 if layer_group_size == 1 else 1,
         mesh=MeshConfig(),
         mb_spec=MicroBatchSpec(n_mbs=n_mbs),
         optimizer=OptimizerConfig(lr=1e-5, warmup_steps_proportion=0.0),
@@ -181,6 +191,14 @@ def _run_on_actor(actor, model_cfg, model_name, n_rows, row_len, seqs_per_row):
     result["device_kind"] = kind
     if peak:
         result["mfu"] = round(model_tflops / peak, 3)
+    # scan shape actually in effect (ISSUE 20 satellite: the silent unroll
+    # fallback is now recorded, not guessed) — the engine computed these at
+    # initialize() from the post-replace model config
+    result["layer_group_size"] = int(
+        max(1, actor.model_config.layer_group_size))
+    result["effective_scan_unroll"] = int(
+        getattr(actor, "_effective_scan_unroll", 1))
+    result["lm_head_chunk"] = int(getattr(actor.config, "lm_head_chunk", 0))
     return result
 
 
@@ -201,18 +219,27 @@ def main():
     from areal_tpu.models.model_config import qwen25_1p5b
 
     # best-throughput workload first (probed on v5e: 8 rows beats 12 —
-    # larger batches hit HBM pressure); smaller fallbacks for smaller chips
+    # larger batches hit HBM pressure); smaller fallbacks for smaller chips.
+    # The two-level scan rungs (ISSUE 20) lead: 28 layers / G=4 = 7 outer
+    # steps, one remat boundary per group, backward scan-transpose carry
+    # ~G× smaller — the ROADMAP 3b plateau was carry-bound, so the grouped
+    # rungs are the headline candidates and the proven G=1 rungs the net
     ladder = [
-        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_attn"),
+        # carry_offload parks the per-group saved activations in pinned
+        # host DRAM between forward and backward — the HBM-relief rung
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "carry_offload", 4),
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "full", 4),
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "full", 2),
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_attn", 1),
         # ROADMAP 3b plateau probe: keep MLP intermediates instead of the
         # attention outputs — the intermediate memory/recompute rung
         # between save_attn and full, aimed at the backward-scan carry
-        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_mlp"),
-        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "full"),
-        (qwen25_1p5b(), "qwen25_1p5b", 4, 2048, 1, "full"),
-        (qwen25_1p5b(), "qwen25_1p5b", 2, 2048, 1, "full"),
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "save_mlp", 1),
+        (qwen25_1p5b(), "qwen25_1p5b", 8, 2048, 1, "full", 1),
+        (qwen25_1p5b(), "qwen25_1p5b", 4, 2048, 1, "full", 1),
+        (qwen25_1p5b(), "qwen25_1p5b", 2, 2048, 1, "full", 1),
         (qwen25_1p5b().replace(num_layers=14), "qwen25_1p5b_half_depth", 2,
-         2048, 1, "full"),
+         2048, 1, "full", 1),
     ]
     result = None
     last_err = None
@@ -220,21 +247,24 @@ def main():
     # rung produced the headline, and what failed on the way there —
     # each attempt records its error TAIL (the HTTP status / exit code of
     # tunneled compile failures lives at the end of the message)
-    for model_cfg, name, n_rows, row_len, n_mbs, policy in ladder:
-        rung = f"{name} x{n_rows}x{row_len} remat={policy}"
+    for model_cfg, name, n_rows, row_len, n_mbs, policy, lgs in ladder:
+        rung = f"{name} x{n_rows}x{row_len} remat={policy} G={lgs}"
         # transient remote_compile HTTP 500s used to forfeit the save_attn
         # rung for the whole round (BENCH_r05: one 500 -> full remat
-        # headline); the upper rung gets ONE retry before falling back
-        tries = 2 if policy in ("save_attn", "save_mlp") else 1
+        # headline); the upper rungs get ONE retry before falling back
+        tries = 2 if policy in ("save_attn", "save_mlp", "carry_offload") \
+            else 1
         for attempt in range(1, tries + 1):
             try:
                 result = _run(model_cfg, name, n_rows, row_len, n_mbs,
-                              remat_policy=policy)
+                              remat_policy=policy, layer_group_size=lgs)
                 attempts.append(
                     {"rung": rung, "attempt": attempt, "ok": True}
                 )
                 result["remat_policy"] = policy
                 result["n_rows"] = n_rows
+                headline_rung = (model_cfg, name, n_rows, row_len, n_mbs,
+                                 policy, lgs)
                 break
             except Exception as e:  # noqa: BLE001 — ladder fall-through
                 last_err = e
@@ -273,6 +303,26 @@ def main():
         raise last_err
     result["attempts"] = attempts
     result["lm_head_impl"] = os.environ.get("AREAL_LM_HEAD_IMPL", "fused")
+
+    # fused LM-head vocab-chunk sweep (ISSUE 20 satellite): the chunk width
+    # was a buried env default (8192); now that it's a plumbed knob, record
+    # the neighbouring widths on the headline workload so the default is
+    # re-justified by data each round.  BENCH_CHUNK_SWEEP=0 skips.
+    if os.environ.get("BENCH_CHUNK_SWEEP", "1") != "0":
+        sweep = {}
+        m_cfg, name, n_rows, row_len, n_mbs, policy, lgs = headline_rung
+        for chunk in (4096, 16384):
+            try:
+                r = _run(m_cfg, name, n_rows, row_len, n_mbs,
+                         remat_policy=policy, layer_group_size=lgs,
+                         lm_head_chunk=chunk)
+                sweep[str(chunk)] = {"tokens_per_sec": r["value"],
+                                     "step_ms": r["step_ms"]}
+            except Exception as e:  # noqa: BLE001 — informational extras
+                print(f"bench: lm_head_chunk={chunk} sweep failed: "
+                      f"{str(e)[:120]}", file=sys.stderr)
+        if sweep:
+            result["lm_head_chunk_sweep"] = sweep
     if args.xla_profile_dir:
         result["xla_profile_dir"] = args.xla_profile_dir
 

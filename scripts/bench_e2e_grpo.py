@@ -148,7 +148,9 @@ class _FakeTokenizer:
 
 def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
                 group_size: int, batch_norm: bool = False,
-                serving_engine: bool = True, share_prefix: bool = True):
+                serving_engine: bool = True, share_prefix: bool = True,
+                layer_group_size: int = 1, remat_policy: str = "full",
+                lm_head_chunk: int = 0, num_layers: int = 0):
     import jax
 
     from areal_tpu.api.config import (
@@ -169,6 +171,10 @@ def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
         cfg = tiny_config(vocab_size=512, qkv_bias=True,
                           hf_architecture="Qwen2ForCausalLM")
     cfg = cfg.replace(eos_token_id=None)
+    if num_layers:
+        # depth override so the two-level scan A/B can group tiny (2-layer
+        # default) models: --num-layers 4 --layer-group-size 4
+        cfg = cfg.replace(num_layers=num_layers)
 
     actor = JaxPPOActor(
         PPOActorConfig(
@@ -177,6 +183,9 @@ def _make_parts(model_scale: str, n_slots: int, max_seq_len: int,
             dtype="bfloat16" if model_scale == "0p6b" else "float32",
             param_dtype="bfloat16" if model_scale == "0p6b" else "float32",
             gradient_checkpointing=True,
+            remat_policy=remat_policy,
+            layer_group_size=layer_group_size,
+            lm_head_chunk=lm_head_chunk,
             mesh=MeshConfig(),
             mb_spec=MicroBatchSpec(n_mbs=1),
             optimizer=OptimizerConfig(lr=1e-6, warmup_steps_proportion=0.0),
@@ -298,6 +307,7 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
     trajs = tokens = 0
     pauses = []
     rewards = []
+    step_stats = []  # per-step PendingTrainStats, materialised after flush
     t_start = None
     if recorder is not None:
         recorder.reset()
@@ -316,7 +326,7 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
         trajs += int(np.asarray(batch["attention_mask"]).shape[0])
         tokens += _batch_tokens(batch)
         rewards.append(float(np.asarray(batch["rewards"]).mean()))
-        _train_consume(actor, batch)
+        step_stats.append(_train_consume(actor, batch))
         pauses.append(publish())
         print(f"{label}{mode} step {step}: trajs={trajs} tokens={tokens}",
               file=sys.stderr, flush=True)
@@ -326,7 +336,19 @@ def _measure_loop(mode: str, actor, get_batch, publish, steps: int,
     jax.block_until_ready(actor.params)
     wall = time.perf_counter() - t_start
     latency = recorder.summary() if recorder is not None else None
+    # per-step training trajectory INCLUDING warmup steps (every step moves
+    # the params, so this is the full optimisation path) — the CI two-level-
+    # scan A/B gates on these being identical across layer_group_size
+    # values.  Group-centred advantages make the step-0 PG loss exactly 0
+    # regardless of params, so entropy/new_logp (which see the real forward
+    # pass) ride along as the non-degenerate signal.
+    def _traj(key):
+        return [round(sum(float(st[key]) for st in step), 8)
+                for step in step_stats]
     return {
+        "loss_trajectory": _traj("loss"),
+        "entropy_trajectory": _traj("entropy"),
+        "new_logp_trajectory": _traj("new_logp"),
         "latency": latency,
         "steps": steps,
         "trajectories": trajs,
@@ -607,6 +629,22 @@ def main():
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=128)
     p.add_argument("--modes", default="sync,async")
+    p.add_argument("--layer-group-size", type=int, default=1,
+                   help="two-level layer scan: layers per remat group "
+                   "(TrainEngineConfig.layer_group_size); must divide the "
+                   "model depth, 1 = classic per-layer scan")
+    p.add_argument("--remat-policy", default="full",
+                   choices=["full", "dots", "save_attn", "save_mlp",
+                            "carry_offload"],
+                   help="per-group remat rung "
+                   "(TrainEngineConfig.remat_policy)")
+    p.add_argument("--lm-head-chunk", type=int, default=0,
+                   help="fused LM-head vocab chunk width "
+                   "(TrainEngineConfig.lm_head_chunk); 0 = env default")
+    p.add_argument("--num-layers", type=int, default=0,
+                   help="model depth override (0 = model default) — lets "
+                   "the tiny 2-layer CPU config run grouped-scan A/Bs at "
+                   "--layer-group-size 4")
     p.add_argument("--warmup", type=int, default=1,
                    help="untimed leading steps; interrupt-publish runs want "
                         "2 so the first post-publish abort storm (whose "
@@ -742,6 +780,10 @@ def main():
         batch_norm=args.workflow == "multi_turn",
         serving_engine=args.transport == "colocated",
         share_prefix=args.share_prefix == "on",
+        layer_group_size=args.layer_group_size,
+        remat_policy=args.remat_policy,
+        lm_head_chunk=args.lm_head_chunk,
+        num_layers=args.num_layers,
     )
     client = server_engine = stop_server = meta = None
     chaos_plan = chaos_proxy = None
@@ -889,6 +931,14 @@ def main():
         "len_jitter": args.len_jitter,
         "publish_mode": args.publish_mode,
         "share_prefix": args.share_prefix,
+        # the scan shape actually compiled (ISSUE 20): group size from the
+        # post-replace model config, unroll after the loud divisor fallback
+        "layer_group_size": int(max(1, actor.model_config.layer_group_size)),
+        "effective_scan_unroll": int(
+            getattr(actor, "_effective_scan_unroll", 1)),
+        "remat_policy": args.remat_policy,
+        "lm_head_chunk": args.lm_head_chunk,
+        "num_layers": int(actor.model_config.num_layers),
         "warm_shapes": [list(s) for s in shapes],
         "warm_s": warm_s,
     }

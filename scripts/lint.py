@@ -72,6 +72,13 @@ REFERENCE_CONFIGS = {
         "spec_rungs": 2,
         "ragged": 1,
     },
+    # ISSUE 20: the two-level layer-grouped train scan — the soak drives 3
+    # distinct (row_len, padded_len) batch signatures twice through one
+    # engine; grouping/remat/unroll are engine-lifetime config and must
+    # mint no signatures of their own
+    "train_scan_soak": {
+        "train_shapes": 3,
+    },
 }
 
 

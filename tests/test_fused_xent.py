@@ -164,3 +164,67 @@ def test_grpo_loss_through_fused_head():
     assert np.all(np.isfinite(np.asarray(dh)))
     assert np.all(np.isfinite(np.asarray(dw)))
     assert float(jnp.abs(dw).sum()) > 0
+
+
+def test_vocab_chunk_knob_plumbs_through_lm_logprobs_entropy():
+    """The plumbed `vocab_chunk` knob (TrainEngineConfig.lm_head_chunk ->
+    loss partials -> here) must agree with the dense reference at widths
+    that do NOT divide the vocab: the final chunk's padded tail is masked,
+    never counted (ISSUE 20 satellite)."""
+    from areal_tpu.models.transformer import LMOutput
+
+    v = 300  # 3 chunks of 128 with a 84-wide padded tail
+    h, w, labels = _rand(n=24, v=v, seed=9)
+    labels2d = labels.reshape(2, 12)
+    out = LMOutput(hidden=h.reshape(2, 12, -1), head=w, aux_loss=None)
+    lp0, ent0, corr0 = _dense(h, w, labels)
+    for chunk in (128, 256, 512):  # dividing and non-dividing widths
+        lp1, ent1, corr1 = lm_logprobs_entropy(
+            out, labels2d, impl="fused", vocab_chunk=chunk
+        )
+        np.testing.assert_allclose(
+            np.asarray(lp1).ravel(), np.asarray(lp0), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ent1).ravel(), np.asarray(ent0), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(corr1).ravel(), np.asarray(corr0)
+        )
+
+
+def test_grpo_loss_fn_vocab_chunk_is_scheduling_only():
+    """grpo_loss_fn(vocab_chunk=...) values/grads are chunk-width
+    invariant — the bench ladder's sweep can't change the optimisation."""
+    from areal_tpu.models.transformer import LMOutput
+    from areal_tpu.ops.functional import grpo_loss_fn
+
+    h, w, labels = _rand(n=32, v=300, seed=10)
+    rng = np.random.default_rng(11)
+    T = 32
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, 300, T), jnp.int32)[None],
+        "loss_mask": jnp.ones((1, T), jnp.float32),
+        "logprobs": jnp.asarray(rng.normal(-1, 0.1, T), jnp.float32)[None],
+        "advantages": jnp.asarray(rng.normal(size=T), jnp.float32)[None],
+        "prox_logp": jnp.asarray(rng.normal(-1, 0.1, T), jnp.float32)[None],
+    }
+
+    def loss(hidden, head, chunk):
+        out = LMOutput(hidden=hidden, head=head, aux_loss=None)
+        l, _ = grpo_loss_fn(out, batch, eps_clip=0.2, vocab_chunk=chunk)
+        return l
+
+    vals, grads = [], []
+    for chunk in (None, 128, 256):
+        val, g = jax.value_and_grad(loss, argnums=(0, 1))(
+            h.reshape(1, T, -1), w, chunk
+        )
+        vals.append(float(val))
+        grads.append(g)
+    np.testing.assert_allclose(vals[1:], vals[0], rtol=1e-6)
+    for dh, dw in grads[1:]:
+        np.testing.assert_allclose(np.asarray(dh), np.asarray(grads[0][0]),
+                                   rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(grads[0][1]),
+                                   rtol=2e-4, atol=1e-6)

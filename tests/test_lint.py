@@ -825,6 +825,63 @@ def test_config_chain_positive_fixture_flags_every_break():
     assert len(findings) == 4, "\n".join(f.render() for f in findings)
 
 
+def test_train_config_chains_are_clean(repo_files):
+    """The ISSUE 20 train chains (layer_group_size / remat_policy /
+    scan_unroll / lm_head_chunk) hold on the real tree."""
+    from areal_tpu.analysis.wire_contracts import check_train_config_plumbing
+
+    findings = check_train_config_plumbing(repo_files, REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_breaking_real_train_chain_flag_is_caught(repo_files):
+    """Acceptance (real code): renaming the bench's --layer-group-size
+    flag breaks the declared train chain."""
+    from areal_tpu.analysis.wire_contracts import check_train_config_plumbing
+
+    rel = os.path.join("scripts", "bench_e2e_grpo.py")
+    src = open(os.path.join(REPO, rel)).read()
+    assert '"--layer-group-size"' in src
+    mutated = src.replace('"--layer-group-size"', '"--layer-groupsize"')
+    files = dict(repo_files)
+    files[rel] = SourceFile("bench_mut", mutated, rel=rel)
+    findings = check_train_config_plumbing(files, REPO)
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("argparse has no '--layer-group-size'" in m for m in msgs)
+
+
+def test_unread_train_chain_flag_is_caught(repo_files):
+    """Acceptance (real code): a train-chain flag whose `args.<dest>` read
+    disappears is parsed-but-dropped."""
+    from areal_tpu.analysis.wire_contracts import check_train_config_plumbing
+
+    rel = os.path.join("scripts", "bench_e2e_grpo.py")
+    src = open(os.path.join(REPO, rel)).read()
+    assert "args.lm_head_chunk" in src
+    mutated = src.replace("args.lm_head_chunk", "args.lm_head_chunk_gone")
+    files = dict(repo_files)
+    files[rel] = SourceFile("bench_mut2", mutated, rel=rel)
+    findings = check_train_config_plumbing(files, REPO)
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("`args.lm_head_chunk` is never read" in m for m in msgs)
+
+
+def test_dropped_model_replace_plumbing_is_caught(repo_files):
+    """Acceptance (real code): the engine's model-config replace() losing
+    the layer_group_size kwarg severs the chain to the backbone."""
+    from areal_tpu.analysis.wire_contracts import check_train_config_plumbing
+
+    rel = os.path.join("areal_tpu", "engine", "jax_train.py")
+    src = open(os.path.join(REPO, rel)).read()
+    assert "layer_group_size=" in src
+    mutated = src.replace("layer_group_size=", "layer_group_size_x=")
+    files = dict(repo_files)
+    files[rel] = SourceFile("engine_mut", mutated, rel=rel)
+    findings = check_train_config_plumbing(files, REPO)
+    msgs = [f.message for f in findings if not f.suppressed]
+    assert any("never plumbs 'layer_group_size'" in m for m in msgs)
+
+
 def test_breaking_real_config_chain_is_caught(repo_files):
     """Acceptance (real code): renaming a gen/server.py argparse flag out
     from under its GenServerConfig chain."""

@@ -3,6 +3,7 @@ with "full" recompute — it only changes the HBM/FLOPs trade."""
 
 import jax
 import numpy as np
+import pytest
 
 from areal_tpu.models import forward, init_params
 from areal_tpu.models.model_config import tiny_config
@@ -46,8 +47,14 @@ def test_scan_unroll_matches_rolled():
     pos = np.broadcast_to(np.arange(L, dtype=np.int32), (B, L))
     seg = np.zeros((B, L), np.int32)
     outs = []
-    for unroll in (1, 2, 4, 3):  # 3 does not divide 4 -> falls back to 1
+    for unroll in (1, 2, 4):
         cfg = base.replace(scan_unroll=unroll)
         outs.append(np.asarray(forward(params, cfg, ids, pos, seg)))
+    # 3 does not divide 4 -> falls back to 1, LOUDLY (ISSUE 20: the silent
+    # fallback used to hide misconfigured ladders)
+    with pytest.warns(UserWarning, match="scan_unroll=3 does not divide"):
+        outs.append(np.asarray(
+            forward(params, base.replace(scan_unroll=3), ids, pos, seg)
+        ))
     for o in outs[1:]:
         np.testing.assert_allclose(outs[0], o, rtol=1e-6)
